@@ -1,0 +1,170 @@
+"""Headline benchmark: Mercury importance-sampled training throughput on one
+TPU chip (images/sec/chip), ResNet-18 @ CIFAR-10 shapes — the reference's
+live config (``pytorch_collab.py:255``, batch 32, 320-candidate pool).
+
+``vs_baseline`` follows BASELINE.json's metric definition — "images/sec/chip
+vs uniform-SGD baseline": the ratio of Mercury-IS training throughput to the
+same fused pipeline with importance sampling disabled (uniform draws, unit
+weights). IS scores a 10× candidate pool per step, so this ratio is the
+per-step cost Mercury pays for its sample-efficiency win; the time-to-
+accuracy comparison is in benchmarks/ (convergence runs need real CIFAR).
+
+An additional diagnostic (not the JSON line) reports the fused step against
+a faithful *unfused* reproduction of the reference's loop structure — 10
+separate scoring forwards + host-side multinomial + separate train step
+(``pytorch_collab.py:95-117``) — i.e. what a direct port would do.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BATCH = 32
+POOL_BATCHES = 10
+WARMUP = 5
+STEPS = 30
+
+
+def _build(use_is: bool = True):
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        model="resnet18",
+        dataset="synthetic",
+        world_size=1,
+        batch_size=BATCH,
+        presample_batches=POOL_BATCHES,
+        use_importance_sampling=use_is,
+        steps_per_epoch=STEPS,
+        num_epochs=1,
+        eval_every=0,
+        log_every=0,
+        seed=0,
+    )
+    mesh = make_mesh(1, config.mesh_axis)
+    return Trainer(config, mesh=mesh)
+
+
+def bench_fused(trainer) -> float:
+    ds = trainer.dataset
+    state = trainer.state
+    for _ in range(WARMUP):
+        state, metrics = trainer.train_step(state, ds.x_train, ds.y_train, ds.shard_indices)
+    jax.block_until_ready(metrics["train/loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = trainer.train_step(state, ds.x_train, ds.y_train, ds.shard_indices)
+    jax.block_until_ready(metrics["train/loss"])
+    dt = time.perf_counter() - t0
+    trainer.state = state
+    return BATCH * STEPS / dt
+
+
+def bench_unfused(trainer) -> float:
+    """Reference-loop-shaped baseline: 10 separate jitted scoring forwards
+    with host-side accumulation + host-side multinomial + separate jitted
+    train step (the structure of ``update_samples`` + ``train``,
+    ``pytorch_collab.py:89-164``)."""
+    from mercury_tpu.sampling.importance import per_sample_loss, reweighted_loss
+
+    from mercury_tpu.models import create_model
+
+    ds, cfg = trainer.dataset, trainer.config
+    # Local (unsynced) BN, like the reference's per-worker nets — and this
+    # baseline runs under plain jit, outside any mesh axis.
+    model = create_model(cfg.model, num_classes=ds.num_classes,
+                         compute_dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype)
+    params = trainer.state.params
+    batch_stats = trainer.state.batch_stats
+    opt_state = trainer.tx.init(params)
+
+    @jax.jit
+    def score_one(params, batch_stats, images, labels):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images, train=True,
+            mutable=["batch_stats"],
+        )
+        return per_sample_loss(logits, labels)
+
+    @jax.jit
+    def train_one(params, batch_stats, opt_state, images, labels, scaled_probs):
+        def loss_fn(p):
+            logits, st = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images, train=True,
+                mutable=["batch_stats"],
+            )
+            return reweighted_loss(per_sample_loss(logits, labels), scaled_probs), st
+
+        (loss, st), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = trainer.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, st["batch_stats"], opt_state, loss
+
+    host_rng = np.random.default_rng(0)
+    x = np.asarray(ds.x_train, np.float32) / 255.0
+    y = np.asarray(ds.y_train)
+    n_train = len(x)
+
+    def one_step(params, batch_stats, opt_state):
+        losses, datas, labels = [], [], []
+        for _ in range(POOL_BATCHES):  # 10 separate device calls (:95)
+            idx = host_rng.integers(0, n_train, BATCH)
+            img = jnp.asarray(x[idx])
+            lab = jnp.asarray(y[idx])
+            losses.append(np.asarray(score_one(params, batch_stats, img, lab)))
+            datas.append(img)
+            labels.append(lab)
+        pool_losses = np.concatenate(losses)  # host cat (:108)
+        scores = pool_losses + 0.5 * pool_losses.mean()
+        probs = scores / scores.sum()
+        sel = host_rng.choice(len(probs), BATCH, replace=True, p=probs)  # host multinomial (:114)
+        pool_x = jnp.concatenate(datas)
+        pool_y = jnp.concatenate(labels)
+        scaled = jnp.asarray(probs[sel] * len(probs), jnp.float32)
+        return train_one(params, batch_stats, opt_state,
+                         pool_x[sel], pool_y[sel], scaled)
+
+    for _ in range(WARMUP):
+        params, batch_stats, opt_state, loss = one_step(params, batch_stats, opt_state)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, batch_stats, opt_state, loss = one_step(params, batch_stats, opt_state)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return BATCH * STEPS / dt
+
+
+def main():
+    import sys
+
+    trainer = _build(use_is=True)
+    fused_ips = bench_fused(trainer)
+    uniform_ips = bench_fused(_build(use_is=False))
+    unfused_ips = bench_unfused(trainer)
+    print(
+        f"# diagnostics: fused_is={fused_ips:.1f} uniform_sgd={uniform_ips:.1f} "
+        f"unfused_reference_loop={unfused_ips:.1f} img/s "
+        f"(fused vs unfused: {fused_ips / unfused_ips:.1f}x)",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "resnet18_cifar10_mercury_is_train_throughput",
+        "value": round(fused_ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(fused_ips / uniform_ips, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""mercury_tpu — a TPU-native (JAX/XLA/pjit) framework for stochastic
+importance-sampled distributed SGD.
+
+Re-implements the capabilities of the Mercury reference system (SenSys 2021,
+"Mercury: Efficient On-Device Distributed DNN Training via Stochastic
+Importance Sampling") as an idiomatic JAX framework:
+
+- ``mercury_tpu.data``      — CIFAR-10/100 ingest, Dirichlet non-IID
+  partitioning, index-carrying batch contract, on-device augmentation.
+- ``mercury_tpu.models``    — Flax model zoo: ResNet-18/34/50/101/152 (CIFAR
+  stem), VGG-11/13/16/19, MobileNetV2, BiLSTM+attention.
+- ``mercury_tpu.sampling``  — the importance-sampling core: candidate scoring,
+  EMA smoothing, with-replacement categorical draws, unbiased reweighting,
+  and the group-wise sliding-window sampler.
+- ``mercury_tpu.parallel``  — SPMD data parallelism over a ``jax.sharding.Mesh``
+  with in-graph ``lax.psum`` gradient + importance-stat reduction, plus an
+  explicit ``lax.ppermute`` ring allreduce.
+- ``mercury_tpu.train``     — Trainer / train-step orchestration, config,
+  eval, timing segments, checkpointing.
+- ``mercury_tpu.utils``     — meters, pytree flatten/unflatten, stochastic
+  quantization, metric logging.
+"""
+
+__version__ = "0.1.0"
+
+from mercury_tpu.config import TrainConfig  # noqa: F401

@@ -1,0 +1,95 @@
+"""Structured run configuration.
+
+Replaces the reference's module-level config globals
+(``pytorch_collab.py:21-33`` — alpha, seed, world_size, model name, noniid
+flag, epochs, linearly-scaled lr, log-dir naming) with a frozen dataclass
+that can be serialized into run names and checkpoints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """All knobs for a Mercury-style training run.
+
+    Defaults mirror the reference's pinned parameters (see BASELINE.md):
+    ResNet-18 on CIFAR-10, 4 workers, batch 32, Adam at 0.001×world_size with
+    cosine decay over 100 epochs, Dirichlet(0.5) non-IID partition, a
+    320-candidate importance pool per step drawn down to 32.
+    """
+
+    # Model / data ----------------------------------------------------------
+    model: str = "resnet18"          # key into mercury_tpu.models.create_model
+    dataset: str = "cifar10"         # "cifar10" | "cifar100" | "synthetic"
+    num_classes: Optional[int] = None  # None → derived from dataset; set → validated
+    image_size: int = 32
+
+    # Parallelism -----------------------------------------------------------
+    world_size: int = 4              # number of data-parallel workers (mesh size)
+    mesh_axis: str = "data"          # name of the data-parallel mesh axis
+
+    # Optimization ----------------------------------------------------------
+    batch_size: int = 32             # per-worker train batch (exp_dataset.py:11,24)
+    base_lr: float = 0.001           # scaled by world_size (pytorch_collab.py:28)
+    optimizer: str = "adam"          # the reference uses Adam (pytorch_collab.py:262)
+    num_epochs: int = 100
+    steps_per_epoch: Optional[int] = None  # None → derived from dataset size
+    step_budget: float = 1e7         # stop when step×world_size exceeds this (pytorch_collab.py:71)
+    weight_decay: float = 0.0
+    label_smoothing: float = 0.0
+
+    # Importance sampling ---------------------------------------------------
+    use_importance_sampling: bool = True
+    presample_batches: int = 10      # candidate pool = 10×batch (pytorch_collab.py:95)
+    is_alpha: float = 0.5            # score = loss + alpha·EMA (pytorch_collab.py:111)
+    ema_alpha: float = 0.9           # EMA smoothing factor (util.py:202)
+    sync_importance_stats: bool = True  # north-star: psum (sum_loss, count) across workers
+
+    # Non-IID partition -----------------------------------------------------
+    noniid: bool = True
+    dirichlet_alpha: float = 0.5     # pytorch_collab.py:21
+    min_shard_size: int = 10         # retry floor (cifar10/data_loader.py:145)
+
+    # BatchNorm strategy: "local" lets per-worker stats drift (reference
+    # behavior — gloo workers never sync BN); "sync" psums batch stats.
+    batch_norm: str = "sync"
+
+    # Bookkeeping -----------------------------------------------------------
+    seed: int = 102                  # pytorch_collab.py:22
+    eval_every: int = 200            # steps (pytorch_collab.py:181)
+    log_every: int = 100             # steps (pytorch_collab.py:170)
+    log_dir: Optional[str] = None
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1000     # steps; 0 disables
+    data_dir: Optional[str] = None   # where CIFAR binaries live; None → search
+
+    # Precision -------------------------------------------------------------
+    compute_dtype: str = "bfloat16"  # MXU-friendly activations/matmuls
+    param_dtype: str = "float32"
+
+    @property
+    def lr(self) -> float:
+        """Linear-scaling rule: base_lr × world_size (pytorch_collab.py:28)."""
+        return self.base_lr * self.world_size
+
+    @property
+    def candidate_pool_size(self) -> int:
+        """Per-step importance candidate count (10×32=320 in the reference)."""
+        return self.presample_batches * self.batch_size
+
+    def run_name(self) -> str:
+        """Config-encoding run name (mirrors the log-dir naming scheme at
+        ``pytorch_collab.py:33``)."""
+        iid = "noniid" if self.noniid else "iid"
+        isp = "is" if self.use_importance_sampling else "uniform"
+        return (
+            f"{self.model}_{self.dataset}_{isp}_{iid}_w{self.world_size}"
+            f"_b{self.batch_size}_lr{self.lr:g}_seed{self.seed}"
+        )
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,12 @@
+from mercury_tpu.data.cifar import load_dataset  # noqa: F401
+from mercury_tpu.data.partition import (  # noqa: F401
+    partition_data,
+    record_class_histograms,
+)
+from mercury_tpu.data.pipeline import (  # noqa: F401
+    Batch,
+    ShardedDataset,
+    augment_batch,
+    make_sharded_dataset,
+    normalize_images,
+)

@@ -1,0 +1,196 @@
+"""CIFAR-10 / CIFAR-100 ingest to host arrays.
+
+Capability parity with the reference's dataset layer: ``load_cifar10_data``
+(``cifar10/data_loader.py:114-123``) and the torchvision-backed
+``CIFAR10_truncated`` (``cifar10/datasets.py:39-96``) / ``My_CIFAR10``
+(``util.py:240-273``). The reference downloads via torchvision; this
+environment has no network egress, so we read the standard on-disk formats
+(python-pickle batches or an ``.npz`` cache) from a data directory, and fall
+back to a deterministic, *learnable* synthetic dataset so tests and smoke
+benchmarks run anywhere.
+
+Index-carrying contract: the reference's ``__getitem__`` returns
+``(index, image, target)`` (``cifar10/datasets.py:93``, ``util.py:262``) so
+importance scores attribute to samples. Here the whole dataset lives in
+memory as arrays and every batching op carries the global index array
+alongside images/labels (see ``mercury_tpu.data.pipeline``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+import warnings
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Standard CIFAR channel statistics — the live non-IID transform normalizes
+# with these (``cifar10/data_loader.py:83-96``).
+CIFAR10_MEAN = np.array([0.49139968, 0.48215827, 0.44653124], np.float32)
+CIFAR10_STD = np.array([0.24703233, 0.24348505, 0.26158768], np.float32)
+CIFAR100_MEAN = np.array([0.5071, 0.4865, 0.4409], np.float32)
+CIFAR100_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
+
+_SEARCH_DIRS = ("data", os.path.expanduser("~/.cache/mercury_tpu"), "/tmp/mercury_tpu_data")
+
+
+def _unpickle(f) -> dict:
+    return pickle.load(f, encoding="latin1")
+
+
+def _load_pickle_batches(batch_dir: str, files, label_key: str) -> Tuple[np.ndarray, np.ndarray]:
+    xs, ys = [], []
+    for name in files:
+        with open(os.path.join(batch_dir, name), "rb") as f:
+            d = _unpickle(f)
+        xs.append(d["data"])
+        ys.append(np.asarray(d[label_key], np.int32))
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NHWC
+    return np.ascontiguousarray(x, np.uint8), np.concatenate(ys)
+
+
+def _try_load_cifar10(root: str):
+    bdir = os.path.join(root, "cifar-10-batches-py")
+    if not os.path.isdir(bdir):
+        tgz = os.path.join(root, "cifar-10-python.tar.gz")
+        if os.path.isfile(tgz):
+            with tarfile.open(tgz) as tf:
+                tf.extractall(root)
+    if os.path.isdir(bdir):
+        train = _load_pickle_batches(bdir, [f"data_batch_{i}" for i in range(1, 6)], "labels")
+        test = _load_pickle_batches(bdir, ["test_batch"], "labels")
+        return train, test
+    npz = os.path.join(root, "cifar10.npz")
+    if os.path.isfile(npz):
+        d = np.load(npz)
+        return (d["x_train"], d["y_train"].astype(np.int32)), (
+            d["x_test"],
+            d["y_test"].astype(np.int32),
+        )
+    return None
+
+
+def _try_load_cifar100(root: str):
+    bdir = os.path.join(root, "cifar-100-python")
+    if not os.path.isdir(bdir):
+        tgz = os.path.join(root, "cifar-100-python.tar.gz")
+        if os.path.isfile(tgz):
+            with tarfile.open(tgz) as tf:
+                tf.extractall(root)
+    if os.path.isdir(bdir):
+        train = _load_pickle_batches(bdir, ["train"], "fine_labels")
+        test = _load_pickle_batches(bdir, ["test"], "fine_labels")
+        return train, test
+    npz = os.path.join(root, "cifar100.npz")
+    if os.path.isfile(npz):
+        d = np.load(npz)
+        return (d["x_train"], d["y_train"].astype(np.int32)), (
+            d["x_test"],
+            d["y_test"].astype(np.int32),
+        )
+    return None
+
+
+def synthetic_cifar(
+    num_classes: int = 10,
+    train_size: int = 5000,
+    test_size: int = 1000,
+    image_size: int = 32,
+    seed: int = 0,
+) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic learnable stand-in for CIFAR when no data is on disk.
+
+    Each class gets a fixed random low-frequency template; samples are the
+    class template plus per-sample noise and a random brightness shift, so a
+    small CNN can separate classes (used by convergence smoke tests) while
+    per-sample difficulty varies (so importance sampling has signal).
+    """
+    rng = np.random.default_rng(seed)
+    # Low-frequency class templates: upsampled 4x4 random patterns.
+    small = rng.normal(0, 1, (num_classes, 4, 4, 3)).astype(np.float32)
+    reps = image_size // 4
+    templates = np.repeat(np.repeat(small, reps, axis=1), reps, axis=2)
+
+    def make(n, offset):
+        local = np.random.default_rng(seed + offset)
+        y = local.integers(0, num_classes, n).astype(np.int32)
+        noise_scale = local.uniform(0.3, 1.5, (n, 1, 1, 1)).astype(np.float32)
+        noise = local.normal(0, 1, (n, image_size, image_size, 3)).astype(np.float32)
+        x = templates[y] + noise_scale * noise
+        x = (x - x.min()) / (x.max() - x.min() + 1e-8)
+        return (x * 255).astype(np.uint8), y
+
+    return make(train_size, 1), make(test_size, 2)
+
+
+def find_data_dir(explicit: Optional[str] = None) -> Optional[str]:
+    """Resolve the dataset root: explicit arg → $MERCURY_TPU_DATA → defaults."""
+    candidates = []
+    if explicit:
+        candidates.append(explicit)
+    env = os.environ.get("MERCURY_TPU_DATA")
+    if env:
+        candidates.append(env)
+    candidates.extend(_SEARCH_DIRS)
+    for c in candidates:
+        if os.path.isdir(c):
+            return c
+    return None
+
+
+def load_dataset(
+    name: str = "cifar10",
+    data_dir: Optional[str] = None,
+    allow_synthetic: bool = True,
+    synthetic_train_size: int = 5000,
+    synthetic_test_size: int = 1000,
+    seed: int = 0,
+) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray], dict]:
+    """Load ``(x_train, y_train), (x_test, y_test), info``.
+
+    Images are uint8 NHWC; labels int32. ``info`` records num_classes,
+    normalization stats, and whether data is synthetic.
+    """
+    name = name.lower()
+    if name == "synthetic":
+        num_classes = 10
+        train, test = synthetic_cifar(
+            num_classes, synthetic_train_size, synthetic_test_size, seed=seed
+        )
+        return train, test, {
+            "num_classes": num_classes,
+            "mean": CIFAR10_MEAN,
+            "std": CIFAR10_STD,
+            "synthetic": True,
+        }
+
+    if name not in ("cifar10", "cifar100"):
+        raise ValueError(f"unknown dataset {name!r}")
+    num_classes = 10 if name == "cifar10" else 100
+    mean, std = (CIFAR10_MEAN, CIFAR10_STD) if name == "cifar10" else (CIFAR100_MEAN, CIFAR100_STD)
+
+    root = find_data_dir(data_dir)
+    loaded = None
+    if root is not None:
+        loader = _try_load_cifar10 if name == "cifar10" else _try_load_cifar100
+        loaded = loader(root)
+    if loaded is not None:
+        train, test = loaded
+        return train, test, {"num_classes": num_classes, "mean": mean, "std": std, "synthetic": False}
+
+    if not allow_synthetic:
+        raise FileNotFoundError(
+            f"no {name} data found under {root or _SEARCH_DIRS}; set MERCURY_TPU_DATA"
+        )
+    warnings.warn(
+        f"no {name} data found on disk — substituting the deterministic "
+        "synthetic dataset. Set MERCURY_TPU_DATA (or pass data_dir) to train "
+        "on real data, or allow_synthetic=False to make this an error.",
+        stacklevel=2,
+    )
+    train, test = synthetic_cifar(
+        num_classes, synthetic_train_size, synthetic_test_size, seed=seed
+    )
+    return train, test, {"num_classes": num_classes, "mean": mean, "std": std, "synthetic": True}

@@ -1,0 +1,105 @@
+"""Non-IID data partitioning.
+
+Capability parity with ``partition_data`` (``cifar10/data_loader.py:126-173``)
+— the FedML-style per-class Dirichlet partitioner with the same sharp-edged
+semantics the reference has (they affect convergence comparability,
+SURVEY.md §7 "hard parts"):
+
+- ``homo``: random equal split (``data_loader.py:132-136``).
+- ``hetero``: for every class, draw Dirichlet(α) proportions over workers,
+  **mask workers already holding ≥ N/n samples** (the ``p·(len(idx_j)<N/n)``
+  capacity mask, ``:153``), renormalize, split the class's shuffled indices at
+  the cumulative proportions — and **retry the entire assignment until every
+  shard has ≥ min_size (10) samples** (``:145``).
+
+Also provides the per-client class-histogram logging of
+``record_net_data_stats`` (``:46-54``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def partition_homo(n_samples: int, n_workers: int, rng: np.random.Generator) -> List[np.ndarray]:
+    """Random equal split (``cifar10/data_loader.py:132-136``)."""
+    idxs = rng.permutation(n_samples)
+    return [np.sort(s).astype(np.int64) for s in np.array_split(idxs, n_workers)]
+
+
+def partition_dirichlet(
+    labels: np.ndarray,
+    n_workers: int,
+    alpha: float,
+    rng: np.random.Generator,
+    min_size: int = 10,
+    max_retries: int = 1000,
+) -> List[np.ndarray]:
+    """Per-class Dirichlet(α) partition with capacity masking and a
+    retry-until-balanced loop (``cifar10/data_loader.py:138-161``)."""
+    labels = np.asarray(labels)
+    n = labels.shape[0]
+    classes = np.unique(labels)
+    target = n / n_workers  # capacity threshold N/n (data_loader.py:153)
+
+    for _ in range(max_retries):
+        shards: List[List[np.ndarray]] = [[] for _ in range(n_workers)]
+        sizes = np.zeros(n_workers, dtype=np.int64)
+        for k in classes:
+            idx_k = np.flatnonzero(labels == k)
+            rng.shuffle(idx_k)
+            proportions = rng.dirichlet(np.repeat(alpha, n_workers))
+            # Capacity mask: workers already at/above the fair share get 0
+            # of this class (data_loader.py:153).
+            proportions = proportions * (sizes < target)
+            s = proportions.sum()
+            if s == 0:  # all workers full for this class — spread evenly
+                proportions = np.full(n_workers, 1.0 / n_workers)
+            else:
+                proportions = proportions / s
+            cuts = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
+            for w, part in enumerate(np.split(idx_k, cuts)):
+                shards[w].append(part)
+                sizes[w] += len(part)
+        if sizes.min() >= min_size:
+            return [np.sort(np.concatenate(s)).astype(np.int64) for s in shards]
+    raise RuntimeError(
+        f"Dirichlet partition failed to reach min shard size {min_size} "
+        f"after {max_retries} retries (α={alpha}, workers={n_workers})"
+    )
+
+
+def partition_data(
+    labels: np.ndarray,
+    n_workers: int,
+    mode: str = "hetero",
+    alpha: float = 0.5,
+    seed: int = 102,
+    min_size: int = 10,
+) -> List[np.ndarray]:
+    """Dispatch matching ``partition_data`` (``cifar10/data_loader.py:126``).
+
+    ``mode``: ``"homo"`` (IID) or ``"hetero"`` (Dirichlet non-IID). Returns a
+    list of sorted global-index arrays, one per worker; shards are disjoint
+    and cover the dataset.
+    """
+    rng = np.random.default_rng(seed)
+    n = int(np.asarray(labels).shape[0])
+    if mode == "homo":
+        return partition_homo(n, n_workers, rng)
+    if mode == "hetero":
+        return partition_dirichlet(labels, n_workers, alpha, rng, min_size=min_size)
+    raise ValueError(f"unknown partition mode {mode!r} (use 'homo' or 'hetero')")
+
+
+def record_class_histograms(
+    labels: np.ndarray, shards: List[np.ndarray]
+) -> List[Dict[int, int]]:
+    """Per-worker class histograms (``cifar10/data_loader.py:46-54``)."""
+    out = []
+    for shard in shards:
+        vals, counts = np.unique(np.asarray(labels)[shard], return_counts=True)
+        out.append({int(v): int(c) for v, c in zip(vals, counts)})
+    return out

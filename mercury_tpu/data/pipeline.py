@@ -1,0 +1,233 @@
+"""On-device data pipeline: index-carrying batches, jit'd augmentation, and
+per-worker presampling streams.
+
+Replaces the reference's loader stack — ``get_dataloader_CIFAR10``
+(``cifar10/data_loader.py:177-211``), the index-carrying datasets
+(``cifar10/datasets.py:39-96``, ``util.py:240-273``), the wrapping
+presampling iterator ``Trainer.get_next`` (``pytorch_collab.py:74-82``) and
+the transforms ``_data_transforms_cifar10``
+(``cifar10/data_loader.py:79-109``) — with a TPU-first design: the whole
+dataset lives in device memory as arrays; "loading" a batch is a gather by
+index inside the jitted step; augmentation is pure ``jax.random`` ops fused
+into the same XLA program. No host↔device transfer per step.
+
+The index-carrying contract (``(index, image, target)``,
+``cifar10/datasets.py:93``) becomes the :class:`Batch` NamedTuple whose
+``index`` field travels with every batch so importance scores attribute to
+global sample ids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Batch(NamedTuple):
+    """Index-carrying batch (mirror of the ``(index, img, target)`` tuple
+    contract, ``cifar10/datasets.py:77-93``)."""
+
+    index: jax.Array  # [B] int32 — global sample ids
+    image: jax.Array  # [B, H, W, C] float
+    label: jax.Array  # [B] int32
+
+
+class ShardStream(NamedTuple):
+    """Carried jit state for one worker's wrapping, shuffled presampling
+    stream (functional replacement of ``Trainer.get_next``'s infinite
+    iterator, ``pytorch_collab.py:74-82``)."""
+
+    perm: jax.Array    # [L] int32 — current epoch permutation of shard slots
+    cursor: jax.Array  # [] int32 — next unread slot
+
+
+def normalize_images(images_u8: jax.Array, mean: np.ndarray, std: np.ndarray) -> jax.Array:
+    """uint8 NHWC → normalized float (``cifar10/data_loader.py:83-96``:
+    ``ToTensor`` + ``Normalize(mean, std)``)."""
+    x = images_u8.astype(jnp.float32) / 255.0
+    return (x - jnp.asarray(mean)) / jnp.asarray(std)
+
+
+def _random_crop_one(key: jax.Array, img: jax.Array, pad: int) -> jax.Array:
+    """Zero-pad by ``pad`` then crop back to the original size at a random
+    offset (``transforms.RandomCrop(32, padding=4)``,
+    ``cifar10/data_loader.py:85``)."""
+    h, w, c = img.shape
+    padded = jnp.pad(img, ((pad, pad), (pad, pad), (0, 0)))
+    oy, ox = jax.random.randint(key, (2,), 0, 2 * pad + 1)
+    return jax.lax.dynamic_slice(padded, (oy, ox, 0), (h, w, c))
+
+
+def _hflip_one(key: jax.Array, img: jax.Array) -> jax.Array:
+    """Random horizontal flip, p=0.5 (``cifar10/data_loader.py:86``)."""
+    return jnp.where(jax.random.bernoulli(key), img[:, ::-1, :], img)
+
+
+def _cutout_one(key: jax.Array, img: jax.Array, length: int) -> jax.Array:
+    """Square cutout mask (``Cutout``, ``cifar10/data_loader.py:57-76`` —
+    defined in the reference but not wired into its transform; exposed here
+    behind a flag). Center is uniform over the image; the square is clipped
+    at the borders, exactly like the reference's ``np.clip`` logic."""
+    h, w, _ = img.shape
+    cy = jax.random.randint(key, (), 0, h)
+    cx = jax.random.randint(jax.random.fold_in(key, 1), (), 0, w)
+    ys = jnp.arange(h)[:, None]
+    xs = jnp.arange(w)[None, :]
+    half = length // 2
+    mask = ((ys >= cy - half) & (ys < cy + half) & (xs >= cx - half) & (xs < cx + half))
+    return jnp.where(mask[..., None], 0.0, img)
+
+
+def augment_batch(
+    key: jax.Array,
+    images: jax.Array,
+    pad: int = 4,
+    use_cutout: bool = False,
+    cutout_length: int = 16,
+) -> jax.Array:
+    """Jit'd train-time augmentation: random crop (pad 4) + horizontal flip
+    [+ optional cutout], vmapped per-sample — the live non-IID pipeline of
+    ``_data_transforms_cifar10`` (``cifar10/data_loader.py:83-96``), run
+    on-device instead of in host worker processes."""
+    n = images.shape[0]
+    keys = jax.random.split(key, 3)
+    crop_keys = jax.random.split(keys[0], n)
+    flip_keys = jax.random.split(keys[1], n)
+    out = jax.vmap(_random_crop_one, in_axes=(0, 0, None))(crop_keys, images, pad)
+    out = jax.vmap(_hflip_one)(flip_keys, out)
+    if use_cutout:
+        cut_keys = jax.random.split(keys[2], n)
+        out = jax.vmap(_cutout_one, in_axes=(0, 0, None))(cut_keys, out, cutout_length)
+    return out
+
+
+def next_pool(
+    stream: ShardStream,
+    key: jax.Array,
+    pool_size: int,
+) -> Tuple[ShardStream, jax.Array]:
+    """Pull the next ``pool_size`` slot positions from a wrapping shuffled
+    stream.
+
+    Functional mirror of the reference's presampling iterator: a shuffled
+    DataLoader consumed batch-by-batch, recreated (reshuffled) when
+    exhausted (``Trainer.get_next``, ``pytorch_collab.py:74-82``). Returns
+    the advanced stream state and ``pool_size`` slot indices into the shard.
+    """
+    length = stream.perm.shape[0]
+    needs_reshuffle = stream.cursor + pool_size > length
+    perm = jax.lax.cond(
+        needs_reshuffle,
+        lambda: jax.random.permutation(key, length).astype(stream.perm.dtype),
+        lambda: stream.perm,
+    )
+    cursor = jnp.where(needs_reshuffle, 0, stream.cursor)
+    slots = jax.lax.dynamic_slice(perm, (cursor,), (pool_size,))
+    return ShardStream(perm=perm, cursor=cursor + pool_size), slots
+
+
+@dataclasses.dataclass
+class ShardedDataset:
+    """Device-resident dataset with per-worker shards.
+
+    The reference ships each fork a pickled per-worker presampling loader
+    plus shared global loaders (``pytorch_collab.py:282-289``). Here, in
+    single-controller SPMD, the full train/test arrays are device-resident
+    (replicated) and each worker's shard is a row of a ``[W, L]`` index
+    matrix — shards of unequal length (Dirichlet!) are cyclically tiled to
+    the max length ``L`` so shapes are static for XLA.
+    """
+
+    x_train: jax.Array        # [N, H, W, C] uint8 (un-normalized; normalize in-step)
+    y_train: jax.Array        # [N] int32
+    x_test: jax.Array         # [Nt, H, W, C] uint8
+    y_test: jax.Array         # [Nt] int32
+    shard_indices: jax.Array  # [W, L] int32 — global ids, cyclically padded
+    shard_sizes: jax.Array    # [W] int32 — true (unpadded) shard lengths
+    mean: np.ndarray
+    std: np.ndarray
+    num_classes: int
+
+    @property
+    def n_train(self) -> int:
+        return int(self.x_train.shape[0])
+
+    @property
+    def n_test(self) -> int:
+        return int(self.x_test.shape[0])
+
+    @property
+    def n_workers(self) -> int:
+        return int(self.shard_indices.shape[0])
+
+    def gather_batch(self, indices: jax.Array, train: bool = True) -> Batch:
+        """Gather a normalized batch by global index (the in-graph analogue
+        of dataset ``__getitem__`` + collate)."""
+        x = self.x_train if train else self.x_test
+        y = self.y_train if train else self.y_test
+        images = normalize_images(x[indices], self.mean, self.std)
+        return Batch(index=indices.astype(jnp.int32), image=images, label=y[indices])
+
+
+def make_sharded_dataset(
+    train: Tuple[np.ndarray, np.ndarray],
+    test: Tuple[np.ndarray, np.ndarray],
+    shards: List[np.ndarray],
+    mean: np.ndarray,
+    std: np.ndarray,
+    num_classes: int,
+) -> ShardedDataset:
+    """Build a :class:`ShardedDataset` from host arrays + partition output.
+
+    Cyclic tiling of short shards keeps shapes static without biasing much:
+    each sample of a short shard simply appears ⌈L/len⌉ times in its row —
+    the same effect as the reference's wrapping presampling iterator
+    re-traversing a short shard more often per global step.
+    """
+    x_train, y_train = train
+    x_test, y_test = test
+    max_len = max(len(s) for s in shards)
+    rows = []
+    for s in shards:
+        reps = int(np.ceil(max_len / len(s)))
+        rows.append(np.tile(s, reps)[:max_len])
+    shard_indices = np.stack(rows).astype(np.int32)
+    shard_sizes = np.array([len(s) for s in shards], np.int32)
+    return ShardedDataset(
+        x_train=jnp.asarray(x_train),
+        y_train=jnp.asarray(y_train, jnp.int32),
+        x_test=jnp.asarray(x_test),
+        y_test=jnp.asarray(y_test, jnp.int32),
+        shard_indices=jnp.asarray(shard_indices),
+        shard_sizes=jnp.asarray(shard_sizes),
+        mean=mean,
+        std=std,
+        num_classes=num_classes,
+    )
+
+
+def init_shard_streams(key: jax.Array, n_workers: int, shard_len: int) -> ShardStream:
+    """Initial per-worker stream state, stacked on a leading worker axis
+    (sharded over the mesh in the SPMD step)."""
+    keys = jax.random.split(key, n_workers)
+    perms = jax.vmap(lambda k: jax.random.permutation(k, shard_len).astype(jnp.int32))(keys)
+    return ShardStream(perm=perms, cursor=jnp.zeros((n_workers,), jnp.int32))
+
+
+def eval_batches(
+    n: int, batch_size: int
+) -> List[Tuple[np.ndarray, int]]:
+    """Host-side fixed-size eval batching plan: list of (index array, valid
+    count); the last batch wraps (padding samples are masked out by the
+    caller using the valid count). Mirrors ``Trainer.evaluate``'s full-pass
+    semantics (``pytorch_collab.py:201-234``) with static shapes."""
+    out = []
+    for start in range(0, n, batch_size):
+        end = min(start + batch_size, n)
+        idx = np.arange(start, start + batch_size) % n
+        out.append((idx.astype(np.int32), end - start))
+    return out

@@ -1,0 +1,87 @@
+"""BiLSTM + additive-attention sequence classifier in Flax.
+
+Capability parity with the reference's speech/audio model: ``Attention``
+(length-masked additive attention pooling over LSTM outputs,
+``pytorch_model.py:156-206`` — mask built per-sequence ``:189-198``) and
+``MyLSTM`` (two stacked bidirectional LSTMs, attention pooling after each,
+concatenated pooled vectors, 2-layer MLP head, ``:208-241``).
+
+TPU-first notes: recurrence runs as ``nn.RNN`` (a ``lax.scan`` under jit —
+static-shape, compiler-friendly); variable lengths are handled with a mask
+(no ragged shapes), exactly the masked-softmax the reference builds by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AdditiveAttention(nn.Module):
+    """Length-masked additive attention pooling (``pytorch_model.py:156-206``).
+
+    ``score_t = v·tanh(W h_t)``; positions ≥ length get -inf before the
+    softmax (the reference's per-sequence mask loop, ``:189-198``); output is
+    the attention-weighted sum of the sequence.
+    """
+
+    attention_dim: int = 128
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, lengths=None):
+        # h: [B, T, D]; lengths: [B] int or None (= full length)
+        scores = nn.Dense(self.attention_dim, dtype=self.compute_dtype,
+                          param_dtype=self.param_dtype)(h)
+        scores = nn.tanh(scores)
+        scores = nn.Dense(1, use_bias=False, dtype=self.compute_dtype,
+                          param_dtype=self.param_dtype)(scores)[..., 0]  # [B, T]
+        if lengths is not None:
+            t = jnp.arange(h.shape[1])[None, :]
+            mask = t < lengths[:, None]
+            scores = jnp.where(mask, scores, -jnp.inf)
+        weights = nn.softmax(scores, axis=-1)  # [B, T]
+        return jnp.einsum("bt,btd->bd", weights, h), weights
+
+
+class BiLSTMAttention(nn.Module):
+    """Two stacked BiLSTMs, each attention-pooled; pooled vectors concat into
+    a 2-layer MLP head (``MyLSTM``, ``pytorch_model.py:208-241``)."""
+
+    num_classes: int
+    hidden_dim: int = 128
+    attention_dim: int = 128
+    mlp_dim: int = 128
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def _bilstm(self, name: str):
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, param_dtype=self.param_dtype),
+                     name=f"{name}_fwd")
+        bwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim, param_dtype=self.param_dtype),
+                     name=f"{name}_bwd")
+        return nn.Bidirectional(fwd, bwd, name=name)
+
+    @nn.compact
+    def __call__(self, x, lengths=None, train: bool = True):
+        # x: [B, T, F] float; lengths: [B] int32 or None
+        x = x.astype(self.compute_dtype)
+        seq_lengths = lengths
+        h1 = self._bilstm("bilstm1")(x, seq_lengths=seq_lengths)   # [B, T, 2H]
+        pooled1, _ = AdditiveAttention(
+            self.attention_dim, self.compute_dtype, self.param_dtype, name="attn1"
+        )(h1, lengths)
+        h2 = self._bilstm("bilstm2")(h1, seq_lengths=seq_lengths)  # [B, T, 2H]
+        pooled2, _ = AdditiveAttention(
+            self.attention_dim, self.compute_dtype, self.param_dtype, name="attn2"
+        )(h2, lengths)
+        z = jnp.concatenate([pooled1, pooled2], axis=-1)           # [B, 4H] (:234)
+        z = nn.Dense(self.mlp_dim, dtype=self.compute_dtype,
+                     param_dtype=self.param_dtype)(z)
+        z = nn.relu(z)
+        z = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     param_dtype=self.param_dtype)(z)
+        return z.astype(jnp.float32)

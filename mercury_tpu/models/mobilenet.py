@@ -1,0 +1,117 @@
+"""MobileNetV2 (CIFAR variant) in Flax.
+
+The reference has **no** MobileNetV2 (SURVEY.md §2.3: "MobileNetV2 does not
+exist in the reference"), but ``BASELINE.json`` config #4 benchmarks it, so
+the model zoo adds the standard architecture (Sandler et al. 2018): inverted
+residual blocks with linear bottlenecks, width 32→1280, expansion 6.
+
+CIFAR adaptation (standard practice for 32×32 inputs): stride-1 stem and the
+first two stride-2 stages reduced to stride 1, so the final feature map stays
+≥4×4 on 32×32 images.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class InvertedResidual(nn.Module):
+    """Expand (1×1) → depthwise 3×3 → project (1×1), residual when shapes match."""
+
+    filters: int
+    strides: int
+    expand: int
+    compute_dtype: jnp.dtype
+    param_dtype: jnp.dtype
+    bn_axis_name: Optional[str]
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def bn():
+            return nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                dtype=self.compute_dtype, param_dtype=self.param_dtype,
+                axis_name=self.bn_axis_name if train else None,
+            )
+
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        y = x
+        if self.expand != 1:
+            y = nn.Conv(hidden, (1, 1), use_bias=False,
+                        dtype=self.compute_dtype, param_dtype=self.param_dtype)(y)
+            y = nn.relu6(bn()(y))
+        y = nn.Conv(
+            hidden, (3, 3), strides=(self.strides, self.strides),
+            feature_group_count=hidden, use_bias=False,
+            dtype=self.compute_dtype, param_dtype=self.param_dtype,
+        )(y)
+        y = nn.relu6(bn()(y))
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.compute_dtype, param_dtype=self.param_dtype)(y)
+        y = bn()(y)  # linear bottleneck — no activation
+        if self.strides == 1 and in_ch == self.filters:
+            y = y + x
+        return y
+
+
+# (expansion t, channels c, repeats n, stride s) — V2 paper Table 2, with the
+# CIFAR stride adaptation in MobileNetV2.__call__.
+_V2_CFG: Tuple[Tuple[int, int, int, int], ...] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 10
+    width_mult: float = 1.0
+    cifar_stem: bool = True  # stride-1 stem + first two down-stages at stride 1
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def bn():
+            return nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                dtype=self.compute_dtype, param_dtype=self.param_dtype,
+                axis_name=self.bn_axis_name if train else None,
+            )
+
+        def c(ch):
+            return max(8, int(ch * self.width_mult))
+
+        x = x.astype(self.compute_dtype)
+        stem_stride = 1 if self.cifar_stem else 2
+        x = nn.Conv(c(32), (3, 3), strides=(stem_stride, stem_stride), use_bias=False,
+                    dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+        x = nn.relu6(bn()(x))
+        downs_reduced = 0
+        for t, ch, n, s in _V2_CFG:
+            for i in range(n):
+                stride = s if i == 0 else 1
+                if self.cifar_stem and stride == 2 and downs_reduced < 2:
+                    stride = 1
+                    downs_reduced += 1
+                x = InvertedResidual(
+                    filters=c(ch), strides=stride, expand=t,
+                    compute_dtype=self.compute_dtype, param_dtype=self.param_dtype,
+                    bn_axis_name=self.bn_axis_name,
+                )(x, train=train)
+        x = nn.Conv(c(1280), (1, 1), use_bias=False,
+                    dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+        x = nn.relu6(bn()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,133 @@
+"""CIFAR-style ResNet family in Flax.
+
+Capability parity with ``pytorch_model.py:14-113``: ``BasicBlock`` (3×3-3×3,
+BN after each conv, 1×1-conv shortcut on stride/width change, ``:14-36``),
+``Bottleneck`` (1×1-3×3-1×1, expansion 4, ``:39-64``), and the CIFAR stem
+``ResNet`` (conv3×3(3→64)+BN — no 7×7/maxpool — 4 stages of widths
+64/128/256/512 at strides 1/2/2/2, global average pool, linear head,
+``:67-97``). Depth configs per ``ResNet18/34/50/101/152`` (``:100-113``).
+
+TPU-first details the reference never faced:
+- activations/matmuls in ``compute_dtype`` (bfloat16 by default) with fp32
+  params — MXU-friendly;
+- BatchNorm can be cross-replica: pass ``bn_axis_name`` to psum batch stats
+  over the data mesh axis (the reference silently lets per-worker BN stats
+  drift — SURVEY.md §7 "hard parts"); ``None`` reproduces local/drifting BN.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """3×3-3×3 residual block (``pytorch_model.py:14-36``)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:  # 1×1-conv shortcut (:25-29)
+            residual = self.conv(
+                self.filters * self.expansion, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class Bottleneck(nn.Module):
+    """1×1-3×3-1×1 bottleneck, expansion 4 (``pytorch_model.py:39-64``)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * self.expansion, (1, 1))(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * self.expansion, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """CIFAR-stem ResNet (``pytorch_model.py:67-97``)."""
+
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int = 10
+    num_filters: int = 64
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    bn_axis_name: Optional[str] = None  # "data" → cross-replica synced BN
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.compute_dtype,
+            param_dtype=self.param_dtype,
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.compute_dtype,
+            param_dtype=self.param_dtype,
+            axis_name=self.bn_axis_name if train else None,
+        )
+        x = x.astype(self.compute_dtype)
+        # CIFAR stem: 3×3 conv, stride 1, no maxpool (pytorch_model.py:72-73)
+        x = conv(self.num_filters, (3, 3))(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        for i, n_blocks in enumerate(self.stage_sizes):  # strides 1/2/2/2 (:74-77)
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i, strides=strides, conv=conv, norm=norm
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global avg pool (≡ 4×4 avg pool, :94)
+        x = nn.Dense(
+            self.num_classes, dtype=self.compute_dtype, param_dtype=self.param_dtype
+        )(x)
+        return x.astype(jnp.float32)  # logits in fp32 for stable loss/softmax
+
+
+# Depth configs (``pytorch_model.py:100-113``).
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck)
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck)
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3], block_cls=Bottleneck)

@@ -1,0 +1,35 @@
+"""Small debug CNN — not in the reference; used by tests and quick smokes
+where a full ResNet is overkill (e.g. CPU-mesh CI). Includes BatchNorm so
+the mutable-batch-stats path is exercised."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SmallCNN(nn.Module):
+    num_classes: int = 10
+    width: int = 16
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.compute_dtype)
+        for i, w in enumerate((self.width, self.width * 2)):
+            x = nn.Conv(w, (3, 3), strides=(2, 2), use_bias=False,
+                        dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+            x = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                dtype=self.compute_dtype, param_dtype=self.param_dtype,
+                axis_name=self.bn_axis_name if train else None,
+            )(x)
+            x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     param_dtype=self.param_dtype)(x)
+        return x.astype(jnp.float32)

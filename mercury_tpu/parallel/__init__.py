@@ -1,0 +1,14 @@
+from mercury_tpu.parallel.collectives import (  # noqa: F401
+    allreduce_mean_tree,
+    psum_stats,
+    ring_allreduce,
+    ring_allreduce_sharded,
+)
+from mercury_tpu.parallel.mesh import (  # noqa: F401
+    data_sharding,
+    host_cpu_mesh,
+    make_mesh,
+    replicate,
+    replicated_sharding,
+    shard_leading_axis,
+)

@@ -1,0 +1,108 @@
+"""In-graph collectives: gradient averaging, importance-stat reduction, and
+an explicit ring allreduce.
+
+Capability parity with the reference's communication layer:
+
+- ``allreduce_mean_tree`` ≡ ``Trainer.average_gradients``
+  (``pytorch_collab.py:236-249``): the reference flattens every gradient
+  into one buffer, does a single gloo ``all_reduce(SUM)``, divides by world
+  size, and unflattens. On TPU the whole pytree pmean happens **in-graph**
+  — XLA fuses/schedules the reduction over ICI; no host round-trip and no
+  manual packing needed.
+- ``allreduce_mean_tree`` on params ≡ ``Trainer.average_model``
+  (``pytorch_collab.py:84-87``), for explicitly re-syncing replicated state.
+- ``psum_stats`` — the north-star cross-worker importance-statistic
+  reduction (sum-loss, count) the reference lacks (SURVEY.md §2.5).
+- ``ring_allreduce`` ≡ the hand-written ring in ``util.py:280-324``: phase 1
+  reduce-scatter (each rank circulates a rotating chunk to its right
+  neighbor for ``size-1`` steps, accumulating), phase 2 all-gather
+  (circulate the reduced chunks for another ``size-1`` steps). Here the
+  point-to-point ``isend``/``recv`` pairs (``util.py:301-318``) become
+  ``lax.ppermute`` ring steps — the direct TPU analogue — inside
+  ``shard_map``. Kept for study/benchmarking against ``lax.psum``, exactly
+  as the reference keeps its ring off the live path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def allreduce_mean_tree(tree: Any, axis_name: str) -> Any:
+    """Average a pytree across the mesh axis (``pytorch_collab.py:236-249``
+    /``:84-87`` in one line — in-graph, fused by XLA)."""
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def psum_stats(sum_value: jax.Array, count: jax.Array, axis_name: str) -> Tuple[jax.Array, jax.Array]:
+    """Reduce (sum, count) pairs across workers — the importance-statistic
+    exchange for a globally consistent EMA (north-star extension)."""
+    return lax.psum(sum_value, axis_name), lax.psum(count, axis_name)
+
+
+def ring_allreduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """Explicit ring allreduce via ``lax.ppermute`` (≡ ``util.py:280-324``).
+
+    Must be called inside ``shard_map`` over ``axis_name``. ``x`` is each
+    rank's local full-size tensor; returns the elementwise **sum** across
+    ranks (like the reference's ring, which sums; its caller divides by
+    world size — ``pytorch_collab.py:244``).
+
+    Chunking mirrors ``util.py:285-290``: the flat tensor splits into
+    ``axis_size`` chunks (zero-padded to equal size, the static-shape
+    analogue of the reference's uneven-last-chunk double buffer).
+    """
+    if axis_size == 1:
+        return x
+    orig_shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    chunk = -(-n // axis_size)  # ceil division
+    padded = jnp.pad(flat, (0, chunk * axis_size - n))
+    chunks = padded.reshape(axis_size, chunk)
+
+    me = lax.axis_index(axis_name)
+    right = [(i, (i + 1) % axis_size) for i in range(axis_size)]  # rank r → r+1 (util.py:292-293)
+
+    def rs_step(s, ch):
+        # Phase 1 — reduce-scatter (util.py:295-306): at step s, rank r sends
+        # chunk (r-s) mod W right and accumulates the incoming chunk into
+        # slot (r-s-1) mod W.
+        send_idx = jnp.mod(me - s, axis_size)
+        incoming = lax.ppermute(ch[send_idx], axis_name, right)
+        recv_idx = jnp.mod(me - s - 1, axis_size)
+        return ch.at[recv_idx].add(incoming)
+
+    chunks = lax.fori_loop(0, axis_size - 1, rs_step, chunks)
+
+    def ag_step(s, ch):
+        # Phase 2 — all-gather (util.py:309-321): circulate the fully
+        # reduced chunks around the ring.
+        send_idx = jnp.mod(me - s + 1, axis_size)
+        incoming = lax.ppermute(ch[send_idx], axis_name, right)
+        recv_idx = jnp.mod(me - s, axis_size)
+        return ch.at[recv_idx].set(incoming)
+
+    chunks = lax.fori_loop(0, axis_size - 1, ag_step, chunks)
+    return chunks.reshape(-1)[:n].reshape(orig_shape)  # re-cat (util.py:324)
+
+
+def ring_allreduce_sharded(mesh: Mesh, x: jax.Array, axis_name: str = "data") -> jax.Array:
+    """Convenience wrapper: run :func:`ring_allreduce` on a replicated array
+    under ``shard_map`` over ``mesh`` and return the summed result."""
+    axis_size = mesh.shape[axis_name]
+    fn = shard_map(
+        partial(ring_allreduce, axis_name=axis_name, axis_size=axis_size),
+        mesh=mesh,
+        in_specs=P(),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(x)

@@ -1,0 +1,76 @@
+"""Device-mesh construction and sharding helpers.
+
+Replaces the reference's process/world machinery — fork-per-worker +
+``dist.init_process_group('gloo')`` (``pytorch_collab.py:269-292``) — with
+single-controller SPMD: one ``jax.sharding.Mesh`` over all TPU devices; the
+"world" is the mesh's data axis; collectives ride ICI in-graph.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    num_devices: Optional[int] = None,
+    axis_name: str = "data",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D data-parallel mesh over ``num_devices`` (default: all devices).
+
+    The mesh size is the TPU analogue of the reference's ``world_size``
+    (``pytorch_collab.py:23``); rank = position along the axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    mesh_devices = mesh_utils.create_device_mesh((len(devices),), devices=list(devices))
+    return Mesh(mesh_devices, (axis_name,))
+
+
+def data_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    """Shard the leading (per-worker) axis across the mesh."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated — the initial parameter broadcast of
+    ``average_model`` (``pytorch_collab.py:84-87``) is free under a
+    replicated sharding: every device holds identical params by
+    construction."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_leading_axis(mesh: Mesh, tree, axis_name: str = "data"):
+    """Device-put a pytree with its leading axis sharded over the mesh."""
+    sharding = data_sharding(mesh, axis_name)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def replicate(mesh: Mesh, tree):
+    """Device-put a pytree fully replicated over the mesh."""
+    sharding = replicated_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def host_cpu_mesh(n: int = 8, axis_name: str = "data") -> Mesh:
+    """Build a mesh over virtual CPU devices (requires
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``) — the CI path
+    for exercising psum/sharding without a pod (SURVEY.md §4)."""
+    cpus = [d for d in jax.devices() if d.platform == "cpu"]
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices, have {len(cpus)}; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count"
+        )
+    return make_mesh(n, axis_name, devices=cpus)

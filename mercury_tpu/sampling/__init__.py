@@ -1,0 +1,19 @@
+from mercury_tpu.sampling.groupwise import (  # noqa: F401
+    GroupwiseState,
+    draw,
+    init_groupwise,
+    update_importance,
+    window_indices,
+)
+from mercury_tpu.sampling.importance import (  # noqa: F401
+    EMAState,
+    SelectionResult,
+    draw_with_replacement,
+    ema_update,
+    importance_probs,
+    init_ema,
+    per_sample_loss,
+    reweighted_loss,
+    select_from_pool,
+    uniform_selection,
+)

@@ -1,0 +1,105 @@
+"""Group-wise sliding-window importance sampler.
+
+Capability parity with ``Groupwise_Sampler`` (``util.py:94-160``) — the
+reference's alternative formulation of Mercury sampling as a dataset-wide
+sampler object: a per-sample ``importance`` array over the *whole* dataset
+(``util.py:109``), a ``group_indicator`` tagging which refresh generation
+each sample's score belongs to (``:108,:133``), an ``update_importance`` that
+re-scores a **sliding window** of the dataset per call and wraps at the end
+(``:114-138``), and draws taken from the **current group only** with scores
+shifted by ``+mean`` and normalized (``:144-153``).
+
+Here the sampler is a functional state machine (NamedTuple + pure updates) so
+it jits and checkpoints. The reference's broken ``__len__``
+(``util.py:160`` references a nonexistent attribute — SURVEY.md "known
+defects") has no analogue; the draw function takes an explicit count.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GroupwiseState(NamedTuple):
+    importance: jax.Array  # [N] float32 — last known per-sample loss/score
+    group: jax.Array       # [N] int32 — refresh generation per sample (util.py:108)
+    cursor: jax.Array      # [] int32 — window start for the next refresh
+    generation: jax.Array  # [] int32 — current group id
+
+
+def init_groupwise(n_samples: int) -> GroupwiseState:
+    """All samples start in generation 0 with uniform importance
+    (``util.py:107-109``)."""
+    return GroupwiseState(
+        importance=jnp.ones((n_samples,), jnp.float32),
+        group=jnp.zeros((n_samples,), jnp.int32),
+        cursor=jnp.zeros((), jnp.int32),
+        generation=jnp.zeros((), jnp.int32),
+    )
+
+
+def window_indices(state: GroupwiseState, window: int) -> jax.Array:
+    """Global indices of the next refresh window, wrapping at the dataset end
+    (``util.py:135-138`` wraps the scan cursor)."""
+    n = state.importance.shape[0]
+    return (state.cursor + jnp.arange(window)) % n
+
+
+def update_importance(
+    state: GroupwiseState, indices: jax.Array, losses: jax.Array
+) -> GroupwiseState:
+    """Write freshly computed per-sample losses into the importance array and
+    advance the window/generation (``update_importance``, ``util.py:114-138``).
+
+    ``indices`` are the global ids just scored (normally
+    ``window_indices(state, w)``); their group tag becomes the new
+    generation, and draws will come from this newest group only.
+    """
+    window = indices.shape[0]
+    new_gen = state.generation + 1
+    importance = state.importance.at[indices].set(losses.astype(jnp.float32))
+    group = state.group.at[indices].set(new_gen)
+    n = state.importance.shape[0]
+    return GroupwiseState(
+        importance=importance,
+        group=group,
+        cursor=(state.cursor + window) % n,
+        generation=new_gen,
+    )
+
+
+def draw(
+    state: GroupwiseState, key: jax.Array, num_draws: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Draw ``num_draws`` global indices from the **current group only**.
+
+    Scores are shifted by the group mean then normalized
+    (``util.py:144-147``: ``p ∝ importance + mean(importance)`` over the
+    group), drawn with replacement (``:150`` draws one at a time with
+    ``multinomial``; i.i.d. categorical is equivalent), and mapped back to
+    global indices (``:152-153``). Returns ``(indices, p_i·M)`` where ``M``
+    is the current group size, so callers can reweight exactly as with the
+    pool sampler.
+    """
+    in_group = state.group == state.generation
+    group_size = jnp.sum(in_group.astype(jnp.float32))
+    mean_imp = jnp.sum(jnp.where(in_group, state.importance, 0.0)) / jnp.maximum(
+        group_size, 1.0
+    )
+    scores = jnp.where(in_group, state.importance + mean_imp, 0.0)  # util.py:144-147
+    scores = jnp.maximum(scores, 0.0)
+    total = jnp.sum(scores)
+    # Degenerate guard: if the group scores sum to 0, fall back to uniform
+    # over the group.
+    probs = jnp.where(
+        total > 0, scores / jnp.maximum(total, 1e-12),
+        in_group.astype(jnp.float32) / jnp.maximum(group_size, 1.0),
+    )
+    selected = jax.random.categorical(
+        key, jnp.log(jnp.maximum(probs, 1e-30)), shape=(num_draws,)
+    ).astype(jnp.int32)
+    scaled = probs[selected] * group_size
+    return selected, scaled

@@ -1,0 +1,156 @@
+"""The Mercury importance-sampling core, as pure jittable functions.
+
+Capability parity with ``Trainer.update_samples`` (``pytorch_collab.py:
+89-117``) and the unbiased reweighting at ``:137``:
+
+1. run inference-only forward passes over a candidate pool of presampled
+   data and take the **per-sample** cross-entropy (``:101-102``);
+2. update an EMA of the mean presampling loss (``:110`` via
+   ``util.py:200-217``);
+3. smooth: ``score_i = loss_i + α·EMA`` (``:111`` — the additive term keeps
+   easy samples drawable);
+4. normalize scores to a distribution ``p_i`` (``:112``);
+5. draw the train batch **with replacement** from ``p`` (``:114``,
+   ``torch.multinomial(..., replacement=True)``);
+6. return ``p_i·N`` for the drawn samples (``:116``) so the training loss
+   ``mean(loss_i / (N·p_i))`` (``:137``) is an unbiased estimator of the
+   uniform-sampling expected loss.
+
+Design deltas from the reference (deliberate, TPU-first):
+- the whole candidate pool is scored in **one batched forward** instead of a
+  10-iteration Python loop — and the reference's wasted per-iteration
+  ``cat``/EMA/``multinomial`` work (``:108-114``, SURVEY.md §2.1) is hoisted
+  so sampling happens exactly once;
+- sampling uses ``jax.random.categorical`` over log-scores — i.i.d. draws ≡
+  multinomial with replacement — keyed by a threaded PRNG key, so runs are
+  deterministic and resumable;
+- an optional ``axis_name`` psums (sum_loss, count) across data-parallel
+  workers before the EMA update, giving a **globally consistent EMA** — the
+  cross-worker importance-statistic exchange the reference lacks
+  (BASELINE.json north-star; SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EMAState(NamedTuple):
+    """In-graph EMA with first-update bootstrap (``util.py:200-217``)."""
+
+    value: jax.Array  # [] float32 — current EMA
+    count: jax.Array  # [] int32 — number of updates (0 → bootstrap next)
+
+
+def init_ema() -> EMAState:
+    return EMAState(value=jnp.zeros((), jnp.float32), count=jnp.zeros((), jnp.int32))
+
+
+def ema_update(state: EMAState, value: jax.Array, alpha: float = 0.9) -> EMAState:
+    """``ema ← α·ema + (1-α)·value`` with bootstrap on first update
+    (``util.py:207-213``)."""
+    value = value.astype(jnp.float32)
+    new = jnp.where(state.count == 0, value, alpha * state.value + (1.0 - alpha) * value)
+    return EMAState(value=new, count=state.count + 1)
+
+
+def per_sample_loss(
+    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
+) -> jax.Array:
+    """Per-sample cross-entropy, ``reduction='none'``
+    (``pytorch_collab.py:102,133``)."""
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+    if label_smoothing > 0.0:
+        smooth = -jnp.mean(log_probs, axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    return nll
+
+
+def importance_probs(
+    losses: jax.Array, ema_value: jax.Array, alpha: float = 0.5
+) -> jax.Array:
+    """Scores → normalized sampling distribution over the candidate pool.
+
+    ``score_i = loss_i + α·EMA`` (``pytorch_collab.py:111``) then
+    ``p = score / Σ score`` (``:112``). Losses are ≥0 so scores are ≥0; a
+    tiny floor guards the all-zero edge case.
+    """
+    scores = losses.astype(jnp.float32) + alpha * ema_value
+    scores = jnp.maximum(scores, 1e-12)
+    return scores / jnp.sum(scores)
+
+
+def draw_with_replacement(
+    key: jax.Array, probs: jax.Array, num_draws: int
+) -> jax.Array:
+    """``torch.multinomial(probs, n, replacement=True)``
+    (``pytorch_collab.py:114``) ≡ ``num_draws`` i.i.d. categorical draws."""
+    return jax.random.categorical(key, jnp.log(probs), shape=(num_draws,))
+
+
+def reweighted_loss(
+    losses: jax.Array, scaled_probs: jax.Array
+) -> jax.Array:
+    """Unbiased IS estimator ``mean(loss_i / (N·p_i))``
+    (``pytorch_collab.py:116,137`` — ``scaled_probs = p_i·N``)."""
+    return jnp.mean(losses / scaled_probs)
+
+
+class SelectionResult(NamedTuple):
+    ema: EMAState
+    selected: jax.Array       # [batch] int32 — positions into the candidate pool
+    scaled_probs: jax.Array   # [batch] float32 — p_i·N for the drawn samples
+    avg_pool_loss: jax.Array  # [] float32 — mean presampling loss (returned at :117)
+
+
+def select_from_pool(
+    key: jax.Array,
+    pool_losses: jax.Array,
+    ema: EMAState,
+    batch_size: int,
+    is_alpha: float = 0.5,
+    ema_alpha: float = 0.9,
+    axis_name: Optional[str] = None,
+) -> SelectionResult:
+    """Full selection step given per-candidate losses — the pure core of
+    ``update_samples`` (``pytorch_collab.py:108-117``), scoring hoisted out
+    of the loop.
+
+    With ``axis_name`` set (inside ``shard_map``), the EMA input is the
+    **global** mean pool loss — psum of (sum, count) over the data axis —
+    so every worker smooths against the same statistic while keeping its own
+    local candidate distribution (the north-star extension).
+    """
+    pool_losses = pool_losses.astype(jnp.float32)
+    n = pool_losses.shape[0]
+    if axis_name is not None:
+        total = jax.lax.psum(jnp.sum(pool_losses), axis_name)
+        count = jax.lax.psum(jnp.asarray(n, jnp.float32), axis_name)
+        mean_loss = total / count
+    else:
+        mean_loss = jnp.mean(pool_losses)
+    new_ema = ema_update(ema, mean_loss, ema_alpha)
+    probs = importance_probs(pool_losses, new_ema.value, is_alpha)
+    selected = draw_with_replacement(key, probs, batch_size)
+    scaled = probs[selected] * n  # p_i·N (pytorch_collab.py:116)
+    return SelectionResult(
+        ema=new_ema,
+        selected=selected.astype(jnp.int32),
+        scaled_probs=scaled,
+        avg_pool_loss=mean_loss,
+    )
+
+
+def uniform_selection(
+    key: jax.Array, pool_size: int, batch_size: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Uniform-sampling control arm (the baseline Mercury is compared
+    against, BASELINE.md config #1): uniform draws with unit weights —
+    ``loss/(N·p) = loss`` when ``p = 1/N``."""
+    selected = jax.random.randint(key, (batch_size,), 0, pool_size)
+    return selected.astype(jnp.int32), jnp.ones((batch_size,), jnp.float32)

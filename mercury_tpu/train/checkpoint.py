@@ -1,0 +1,85 @@
+"""Checkpoint / resume.
+
+The reference has **no** checkpointing (SURVEY.md §5 — no ``torch.save``
+anywhere; a crash loses the run). Here the whole :class:`MercuryState`
+pytree — params, optimizer state, BN stats, **and** the sampler state (EMA,
+presample streams, per-worker RNG keys) — serializes, so importance-sampled
+training resumes bit-deterministically.
+
+Primary backend is Orbax (the idiomatic JAX checkpointer); a msgpack
+fallback (``flax.serialization``) covers environments where Orbax's API is
+unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+def _orbax():
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except Exception:
+        return None
+
+
+def _ckpt_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"ckpt_{step}")
+
+
+def save_checkpoint(directory: str, state: Any, step: int) -> str:
+    """Save ``state`` under ``directory/ckpt_<step>``."""
+    os.makedirs(directory, exist_ok=True)
+    path = _ckpt_path(directory, step)
+    ocp = _orbax()
+    if ocp is not None:
+        try:
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.abspath(path), jax.device_get(state), force=True)
+            return path
+        except Exception:
+            pass
+    import flax.serialization
+
+    with open(path + ".msgpack", "wb") as f:
+        f.write(flax.serialization.to_bytes(jax.device_get(state)))
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest checkpoint step in ``directory``, or None."""
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        m = re.fullmatch(r"ckpt_(\d+)(\.msgpack)?", name)
+        if m:
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None) -> Tuple[Any, int]:
+    """Restore the checkpoint at ``step`` (default: latest) into the
+    structure of ``template`` (a live state used for pytree/shape/dtype
+    reference). Returns ``(state, step)``."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = _ckpt_path(directory, step)
+    ocp = _orbax()
+    if os.path.isdir(path) and ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.abspath(path), item=jax.device_get(template))
+        return restored, step
+    import flax.serialization
+
+    with open(path + ".msgpack", "rb") as f:
+        restored = flax.serialization.from_bytes(jax.device_get(template), f.read())
+    return restored, step

@@ -1,0 +1,97 @@
+"""Training state: params, BN stats, optimizer state, and the Mercury
+sampler state (EMA + per-worker presampling streams + RNG).
+
+The reference scatters this state across a ``Trainer`` object's attributes
+(``pytorch_collab.py:38-54`` — net/optimizer/loaders/``next_batch_iter``/
+EMA meter). Here it is one pytree, so the whole training step is a pure
+function ``state → state`` and the entire thing checkpoints/resumes
+deterministically (including sampler RNG — SURVEY.md §5's checkpoint gap).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from mercury_tpu.data.pipeline import ShardStream
+from mercury_tpu.sampling.importance import EMAState, init_ema
+
+
+@flax.struct.dataclass
+class MercuryState:
+    step: jax.Array                 # [] int32 — global step counter
+    params: Any                     # model params (replicated over mesh)
+    batch_stats: Any                # BN running stats (replicated)
+    opt_state: Any                  # optax state (replicated)
+    ema: EMAState                   # [W]-stacked per-worker EMA of mean pool loss
+    stream: ShardStream             # [W]-stacked per-worker presample streams
+    rng: jax.Array                  # [W, key] per-worker PRNG keys
+
+
+def create_state(
+    rng: jax.Array,
+    model,
+    tx: optax.GradientTransformation,
+    sample_batch: jax.Array,
+    n_workers: int,
+    shard_len: int,
+) -> MercuryState:
+    """Initialize model/optimizer/sampler state.
+
+    Initial cross-worker parameter sync (``Trainer.average_model``,
+    ``pytorch_collab.py:84-87``) is implicit: params are created once and
+    placed replicated — every device starts from identical weights.
+    """
+    from mercury_tpu.data.pipeline import init_shard_streams
+
+    init_key, stream_key, worker_key = jax.random.split(rng, 3)
+    variables = model.init(init_key, sample_batch, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = tx.init(params)
+    ema0 = init_ema()
+    ema = EMAState(
+        value=jnp.zeros((n_workers,), jnp.float32) + ema0.value,
+        count=jnp.zeros((n_workers,), jnp.int32) + ema0.count,
+    )
+    stream = init_shard_streams(stream_key, n_workers, shard_len)
+    worker_keys = jax.random.split(worker_key, n_workers)
+    return MercuryState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+        ema=ema,
+        stream=stream,
+        rng=worker_keys,
+    )
+
+
+def make_optimizer(
+    name: str,
+    lr: float,
+    total_steps: int,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Adam + cosine decay — the reference's recipe: ``optim.Adam`` at
+    ``0.001×world_size`` (``pytorch_collab.py:262,28``) under
+    ``CosineAnnealingLR`` over the full run (``:62``). The reference steps
+    its scheduler per epoch; here the schedule is per-step (smooth cosine to
+    the same endpoint). ``sgd`` is provided as the uniform-baseline control.
+    """
+    schedule = optax.cosine_decay_schedule(lr, decay_steps=max(total_steps, 1))
+    if name == "adam":
+        opt = optax.adam(schedule)
+    elif name == "adamw":
+        opt = optax.adamw(schedule, weight_decay=weight_decay)
+    elif name == "sgd":
+        opt = optax.sgd(schedule, momentum=0.9)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    if weight_decay and name == "adam":
+        opt = optax.chain(optax.add_decayed_weights(weight_decay), opt)
+    return opt

@@ -1,0 +1,214 @@
+"""The fused SPMD Mercury train step.
+
+One jitted ``shard_map`` program per step does everything the reference's hot
+loop does across Python/gloo boundaries (``pytorch_collab.py:119-199`` —
+SURVEY.md §3.2): pull presample candidates, score them (10 inference
+forwards in the reference — here **one batched forward** over the whole
+pool), EMA-smooth, draw the train batch with replacement, compute the
+unbiased reweighted loss, backprop, allreduce gradients, and apply the
+optimizer — with the collectives (gradient pmean ≡ ``average_gradients``
+``:236-249``, importance-stat psum = north-star extension) fused in-graph by
+XLA. The compute/communication overlap the reference only gestures at in
+commented-out thread code (``:154-156``) falls out for free: XLA schedules
+the ICI collectives asynchronously against independent compute.
+
+Per-worker divergence (the whole point of Mercury on non-IID data: each
+worker scores its *own* Dirichlet shard) lives on the mesh's data axis:
+shard index rows, presample streams, EMAs, and RNG keys are ``[W]``-stacked
+and sharded; params/optimizer state are replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.data.pipeline import ShardStream, augment_batch, next_pool, normalize_images
+from mercury_tpu.parallel.collectives import allreduce_mean_tree
+from mercury_tpu.sampling.importance import (
+    EMAState,
+    per_sample_loss,
+    reweighted_loss,
+    select_from_pool,
+)
+from mercury_tpu.train.state import MercuryState
+
+from jax import shard_map
+
+
+def _state_specs(axis: str) -> MercuryState:
+    """PartitionSpec pytree-prefix for :class:`MercuryState`: model/opt state
+    replicated, per-worker sampler state sharded along the data axis."""
+    return MercuryState(
+        step=P(),
+        params=P(),
+        batch_stats=P(),
+        opt_state=P(),
+        ema=EMAState(value=P(axis), count=P(axis)),
+        stream=ShardStream(perm=P(axis), cursor=P(axis)),
+        rng=P(axis),
+    )
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    config: TrainConfig,
+    mesh: Mesh,
+    mean: np.ndarray,
+    std: np.ndarray,
+) -> Callable[..., Tuple[MercuryState, Dict[str, jax.Array]]]:
+    """Build the jitted train step.
+
+    Returns ``step_fn(state, x_train, y_train, shard_indices) →
+    (new_state, metrics)`` where ``x_train``/``y_train`` are the full
+    device-resident train arrays (replicated) and ``shard_indices`` is the
+    ``[W, L]`` per-worker index matrix (sharded over the data axis).
+    """
+    axis = config.mesh_axis
+    use_is = config.use_importance_sampling
+    pool_size = config.candidate_pool_size if use_is else config.batch_size
+    batch_size = config.batch_size
+    stat_axis = axis if (use_is and config.sync_importance_stats) else None
+
+    def _apply_train(params, batch_stats, images, keep_stats: bool):
+        """Train-mode forward. ``keep_stats=False`` (the scoring pass) uses
+        batch statistics for normalization but discards the running-stat
+        update — the clean version of the reference's quirk where
+        ``update_samples``'s no_grad forwards still mutate BN running means
+        (``pytorch_collab.py:101`` runs the net in train mode)."""
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            logits, new_model_state = model.apply(
+                variables, images, train=True, mutable=["batch_stats"]
+            )
+            new_stats = new_model_state["batch_stats"] if keep_stats else batch_stats
+            return logits, new_stats
+        return model.apply(variables, images, train=True), batch_stats
+
+    def body(state: MercuryState, x_train, y_train, shard_indices):
+        # Leading axis inside shard_map is this device's single worker row.
+        rng = state.rng[0]
+        k_stream, k_aug, k_sel, k_next = jax.random.split(rng, 4)
+
+        # --- presample pool: next `pool_size` samples of this worker's shard
+        # (≡ Trainer.get_next over the presampling loader, :74-82) ----------
+        stream = ShardStream(perm=state.stream.perm[0], cursor=state.stream.cursor[0])
+        stream, slots = next_pool(stream, k_stream, pool_size)
+        global_idx = shard_indices[0][slots]
+        images = normalize_images(x_train[global_idx], mean, std)
+        images = augment_batch(k_aug, images)
+        labels = y_train[global_idx]
+
+        ema = EMAState(value=state.ema.value[0], count=state.ema.count[0])
+
+        if use_is:
+            # --- importance scoring: ONE batched inference forward over the
+            # pool (≡ the 10-iteration no_grad loop, :95-106), batch-stat
+            # normalization, running-stat updates discarded ----------------
+            pool_logits, _ = _apply_train(state.params, state.batch_stats, images, False)
+            pool_losses = per_sample_loss(pool_logits, labels)
+            sel = select_from_pool(
+                k_sel, pool_losses, ema, batch_size,
+                is_alpha=config.is_alpha, ema_alpha=config.ema_alpha,
+                axis_name=stat_axis,
+            )
+            selected, scaled_probs = sel.selected, sel.scaled_probs
+            ema = sel.ema
+            avg_pool_loss = sel.avg_pool_loss
+        else:
+            # Uniform baseline: consume the freshly streamed batch directly —
+            # the stream is a shuffled without-replacement epoch pass, i.e.
+            # standard shuffled-loader SGD — with unit IS weights so
+            # loss/(N·p) = loss.
+            selected = jnp.arange(batch_size, dtype=jnp.int32)
+            scaled_probs = jnp.ones((batch_size,), jnp.float32)
+            avg_pool_loss = jnp.zeros((), jnp.float32)
+
+        sel_images = images[selected]
+        sel_labels = labels[selected]
+
+        # --- train forward/backward with the unbiased IS reweighting
+        # mean(loss_i/(N·p_i)) (:132-148) --------------------------------
+        def loss_fn(params):
+            logits, new_bs = _apply_train(params, state.batch_stats, sel_images, True)
+            losses = per_sample_loss(logits, sel_labels, config.label_smoothing)
+            return reweighted_loss(losses, scaled_probs), (logits, new_bs)
+
+        (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+
+        # --- gradient allreduce (≡ average_gradients, :236-249) — in-graph
+        grads = allreduce_mean_tree(grads, axis)
+        loss_mean = lax.pmean(loss, axis)
+        correct = lax.psum(
+            jnp.sum((jnp.argmax(logits, -1) == sel_labels).astype(jnp.float32)), axis
+        )
+        count = lax.psum(jnp.asarray(batch_size, jnp.float32), axis)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        # Keep replicated BN stats replicated: under synced BN they already
+        # agree; under local BN we average the running stats across workers
+        # (normalization still used local batch stats this step).
+        if new_batch_stats:
+            new_batch_stats = allreduce_mean_tree(new_batch_stats, axis)
+
+        new_state = MercuryState(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_batch_stats,
+            opt_state=new_opt_state,
+            ema=EMAState(value=ema.value[None], count=ema.count[None]),
+            stream=ShardStream(perm=stream.perm[None], cursor=stream.cursor[None]),
+            rng=k_next[None],
+        )
+        metrics = {
+            "train/loss": loss_mean,
+            "train/acc": correct / count,
+            "train/pool_loss": lax.pmean(avg_pool_loss, axis),
+        }
+        return new_state, metrics
+
+    specs = _state_specs(axis)
+    sharded = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(specs, P(), P(), P(axis)),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_eval_step(model) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array]]:
+    """Jitted eval on one fixed-size batch with a validity mask.
+
+    ≡ the inner loop of ``Trainer.evaluate`` (``pytorch_collab.py:201-234``):
+    inference-mode forward (BN running averages — the ``eval()`` flip at
+    ``:207``), summed loss/correct counts. Returns
+    ``(loss_sum, correct, n)`` for meter accumulation.
+    """
+
+    def eval_fn(params, batch_stats, images, labels, valid_n):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits = model.apply(variables, images, train=False)
+        losses = per_sample_loss(logits, labels)
+        mask = (jnp.arange(images.shape[0]) < valid_n).astype(jnp.float32)
+        loss_sum = jnp.sum(losses * mask)
+        correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.float32) * mask)
+        return loss_sum, correct, jnp.sum(mask)
+
+    return jax.jit(eval_fn)

@@ -1,0 +1,8 @@
+from mercury_tpu.utils.meters import Accuracy, Average, EMAverage  # noqa: F401
+from mercury_tpu.utils.tree import (  # noqa: F401
+    flatten_arrays,
+    tree_flatten_to_vector,
+    tree_unflatten_from_vector,
+    unflatten_arrays,
+)
+from mercury_tpu.utils.quantize import stochastic_quantize  # noqa: F401

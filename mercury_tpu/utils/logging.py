@@ -1,0 +1,56 @@
+"""Metrics logging.
+
+Capability parity with the reference's rank-0 TensorBoardX scalar logging
+(``pytorch_collab.py:58-59,187-190`` — ``train/acc``, ``test/acc``,
+``train/loss``, ``test/loss`` keyed by step) plus stdout prints
+(``:170-178``). Writes step-keyed scalars to a JSONL file always, and to
+TensorBoard event files when a TensorBoard writer is importable (it is an
+optional dependency; the framework must not require it)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+def _try_tensorboard_writer(log_dir: str):
+    try:  # torch ships a tensorboard writer; fall back silently if absent
+        from torch.utils.tensorboard import SummaryWriter  # type: ignore
+
+        return SummaryWriter(log_dir=log_dir)
+    except Exception:
+        return None
+
+
+class MetricsLogger:
+    """Step-keyed scalar logger: JSONL always, TensorBoard when available."""
+
+    def __init__(self, log_dir: Optional[str], enabled: bool = True) -> None:
+        self.enabled = enabled and log_dir is not None
+        self._tb = None
+        self._jsonl = None
+        if self.enabled:
+            os.makedirs(log_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+            self._tb = _try_tensorboard_writer(log_dir)
+
+    def log_scalars(self, step: int, scalars: Dict[str, float]) -> None:
+        """Log a dict of tag→value at ``step`` (tags like ``train/acc``,
+        mirroring ``pytorch_collab.py:187-190``)."""
+        if not self.enabled:
+            return
+        record = {"step": int(step), "time": time.time()}
+        record.update({k: float(v) for k, v in scalars.items()})
+        self._jsonl.write(json.dumps(record) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            for tag, value in scalars.items():
+                self._tb.add_scalar(tag, float(value), int(step))
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()

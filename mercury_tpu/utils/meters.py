@@ -1,0 +1,100 @@
+"""Host-side metric meters.
+
+Capability parity with the reference's meter classes: ``Average``
+(``util.py:183-198``), ``EMAverage`` (``util.py:200-217``), ``Accuracy``
+(``util.py:220-238``). These run on the host and accept numpy/JAX scalars;
+the *in-graph* EMA used by the importance sampler lives in
+``mercury_tpu.sampling.importance`` as carried jit state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Average:
+    """Running weighted mean (``util.py:183-198``)."""
+
+    def __init__(self) -> None:
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, value, number: int = 1) -> None:
+        self.sum += float(value) * number
+        self.count += number
+
+    @property
+    def average(self) -> float:
+        if self.count == 0:
+            return 0.0
+        return self.sum / self.count
+
+    def reset(self) -> None:
+        self.sum = 0.0
+        self.count = 0
+
+    def __str__(self) -> str:
+        return f"{self.average:.6f}"
+
+
+class EMAverage:
+    """Exponential moving average with first-update bootstrap
+    (``util.py:200-217``): the first ``update`` sets the EMA to the raw value;
+    later updates blend ``alpha·ema + (1-alpha)·value``."""
+
+    def __init__(self, alpha: float = 0.9) -> None:
+        self.alpha = alpha
+        self.value = 0.0
+        self.count = 0
+
+    def update(self, value, number: int = 1) -> None:
+        value = float(value)
+        if self.count == 0:
+            self.value = value  # bootstrap (util.py:209-211)
+        else:
+            self.value = self.alpha * self.value + (1.0 - self.alpha) * value
+        self.count += number
+
+    @property
+    def average(self) -> float:
+        return self.value
+
+    def reset(self) -> None:
+        self.value = 0.0
+        self.count = 0
+
+    def __str__(self) -> str:
+        return f"{self.average:.6f}"
+
+
+class Accuracy:
+    """Argmax accuracy meter (``util.py:220-238``)."""
+
+    def __init__(self) -> None:
+        self.correct = 0
+        self.count = 0
+
+    def update(self, logits, targets) -> None:
+        logits = np.asarray(logits)
+        targets = np.asarray(targets)
+        preds = logits.argmax(axis=-1)
+        self.correct += int((preds == targets).sum())
+        self.count += int(targets.shape[0])
+
+    def update_counts(self, correct: int, count: int) -> None:
+        """Accumulate pre-reduced counts (e.g. psum'd across workers)."""
+        self.correct += int(correct)
+        self.count += int(count)
+
+    @property
+    def accuracy(self) -> float:
+        if self.count == 0:
+            return 0.0
+        return self.correct / self.count
+
+    def reset(self) -> None:
+        self.correct = 0
+        self.count = 0
+
+    def __str__(self) -> str:
+        return f"{self.accuracy * 100:.2f}%"

@@ -1,0 +1,32 @@
+"""Stochastic gradient quantization.
+
+Capability parity with ``quantize_tensor`` (``util.py:65-70``): the
+reference's (dead-code) gradient-compression experiment quantizes a tensor to
+``sign(a) · max|a| · Bernoulli(|a|/max|a|)`` — an unbiased one-bit-magnitude
+stochastic quantizer. Here it is a pure jittable transform usable inside a
+train step (e.g. before a compressed allreduce)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stochastic_quantize(key: jax.Array, a: jax.Array) -> jax.Array:
+    """Unbiased stochastic Bernoulli quantization (``util.py:65-70``).
+
+    Each element becomes ``sign(a)·max|a|`` with probability ``|a|/max|a|``
+    and 0 otherwise, so ``E[q] = a`` elementwise.
+    """
+    amax = jnp.max(jnp.abs(a))
+    # Guard the all-zero tensor: probability 0 everywhere, output 0.
+    safe_max = jnp.where(amax > 0, amax, 1.0)
+    prob = jnp.abs(a) / safe_max
+    draw = jax.random.bernoulli(key, prob)
+    return jnp.sign(a) * amax * draw.astype(a.dtype)
+
+
+def sparsity(a: jax.Array) -> jax.Array:
+    """Fraction of nonzero elements — the "sparse rate" the reference logs
+    from its vestigial ``com_tensor`` (``pytorch_collab.py:184-185``)."""
+    return jnp.mean((a != 0).astype(jnp.float32))

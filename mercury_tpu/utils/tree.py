@@ -1,0 +1,72 @@
+"""Flatten/unflatten between pytrees (or array lists) and one contiguous
+vector.
+
+Capability parity with the reference's buffer packing — ``flatten`` /
+``unflatten`` for numpy (``util.py:12-44``) and
+``flatten_torch_tensor`` / ``unflatten_torch_tensor`` (``util.py:23-25,
+46-63``), which the reference uses to ship all gradients through a single
+``all_reduce`` (``pytorch_collab.py:236-249``).
+
+On TPU this packing is *not* needed for communication — XLA fuses the psum of
+a whole gradient pytree in-graph — but a single-vector view is still useful
+(gradient-norm clipping, compression experiments, debugging), so we provide
+jit-compatible versions built on ``jax.flatten_util.ravel_pytree`` plus a
+shape-driven list variant that mirrors the reference's exact-consumption
+assertion (``util.py:43,62``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def tree_flatten_to_vector(tree: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
+    """Flatten a pytree of arrays to one 1-D vector.
+
+    Returns ``(vector, unravel)`` where ``unravel(vector)`` reproduces the
+    original pytree structure. The TPU analogue of
+    ``flatten_torch_tensor`` (``util.py:23-25``).
+    """
+    return ravel_pytree(tree)
+
+
+def tree_unflatten_from_vector(vector: jax.Array, unravel: Callable[[jax.Array], Any]) -> Any:
+    """Inverse of :func:`tree_flatten_to_vector` (``util.py:46-63``)."""
+    return unravel(vector)
+
+
+def flatten_arrays(arrays: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate a flat list of arrays into one 1-D vector
+    (list-of-tensors form of ``util.py:23-25``)."""
+    return jnp.concatenate([jnp.ravel(a) for a in arrays])
+
+
+def unflatten_arrays(vector: jax.Array, prototypes: Sequence[jax.Array]) -> List[jax.Array]:
+    """Split ``vector`` back into arrays shaped like ``prototypes``.
+
+    Shape-driven inverse with the exact-consumption check of ``util.py:43,62``
+    (the reference asserts the flat buffer is consumed to the last element).
+    """
+    total = sum(int(p.size) for p in prototypes)
+    if vector.shape != (total,):
+        raise ValueError(
+            f"flat vector has shape {vector.shape}, prototypes need ({total},)"
+        )
+    out: List[jax.Array] = []
+    offset = 0
+    for p in prototypes:
+        n = int(p.size)
+        out.append(vector[offset : offset + n].reshape(p.shape))
+        offset += n
+    assert offset == total  # exact consumption (util.py:43)
+    return out
+
+
+def global_norm(tree: Any) -> jax.Array:
+    """L2 norm over every leaf of a pytree (handy for grad diagnostics)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))

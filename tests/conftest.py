@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU platform so psum/sharding
+logic is exercised without a TPU pod (SURVEY.md §4's multi-device test
+strategy).
+
+Note: this environment's sitecustomize registers a remote-TPU ("axon") PJRT
+backend at interpreter start and pins ``JAX_PLATFORMS=axon``, so an env-var
+``setdefault`` is not enough — we must set the XLA host-device flag before
+backend init and override the platform via ``jax.config``."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,164 @@
+"""Data layer tests: Dirichlet partition invariants (disjoint, cover,
+min-size — mirror of data_loader.py:145), pipeline contract, augmentation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mercury_tpu.data import (
+    augment_batch,
+    load_dataset,
+    make_sharded_dataset,
+    normalize_images,
+    partition_data,
+    record_class_histograms,
+)
+from mercury_tpu.data.cifar import CIFAR10_MEAN, CIFAR10_STD, synthetic_cifar
+from mercury_tpu.data.pipeline import ShardStream, eval_batches, init_shard_streams, next_pool
+
+
+@pytest.fixture(scope="module")
+def labels():
+    return np.random.default_rng(0).integers(0, 10, 2000).astype(np.int32)
+
+
+class TestPartition:
+    def test_hetero_disjoint_and_cover(self, labels):
+        shards = partition_data(labels, 4, mode="hetero", alpha=0.5, seed=102)
+        allidx = np.concatenate(shards)
+        assert len(allidx) == len(labels)
+        assert len(np.unique(allidx)) == len(labels)  # disjoint + cover
+
+    def test_hetero_min_size(self, labels):
+        # Retry loop guarantees every shard ≥ 10 (data_loader.py:145).
+        shards = partition_data(labels, 8, mode="hetero", alpha=0.1, seed=102)
+        assert min(len(s) for s in shards) >= 10
+
+    def test_hetero_is_heterogeneous(self, labels):
+        """Low α must produce skewed class distributions (the point of the
+        Dirichlet partition)."""
+        shards = partition_data(labels, 4, mode="hetero", alpha=0.1, seed=102)
+        hists = record_class_histograms(labels, shards)
+        # At least one worker should be missing some class or heavily skewed.
+        fracs = []
+        for h in hists:
+            total = sum(h.values())
+            top = max(h.values())
+            fracs.append(top / total)
+        assert max(fracs) > 0.25  # well above the uniform 10%
+
+    def test_homo_equal_split(self, labels):
+        shards = partition_data(labels, 4, mode="homo", seed=0)
+        sizes = [len(s) for s in shards]
+        assert max(sizes) - min(sizes) <= 1
+        assert sum(sizes) == len(labels)
+
+    def test_deterministic_given_seed(self, labels):
+        a = partition_data(labels, 4, mode="hetero", alpha=0.5, seed=7)
+        b = partition_data(labels, 4, mode="hetero", alpha=0.5, seed=7)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+class TestCifarLoad:
+    def test_synthetic_fallback_shapes(self):
+        train, test, info = load_dataset("synthetic", synthetic_train_size=256,
+                                         synthetic_test_size=64)
+        x, y = train
+        assert x.shape == (256, 32, 32, 3) and x.dtype == np.uint8
+        assert y.shape == (256,) and y.dtype == np.int32
+        assert info["num_classes"] == 10
+
+    def test_synthetic_deterministic(self):
+        a, _, _ = load_dataset("synthetic", synthetic_train_size=64, seed=3)
+        b, _, _ = load_dataset("synthetic", synthetic_train_size=64, seed=3)
+        np.testing.assert_array_equal(a[0], b[0])
+
+    def test_synthetic_learnable_structure(self):
+        """Class templates must separate: same-class images correlate more
+        than cross-class on average."""
+        (x, y), _, _ = load_dataset("synthetic", synthetic_train_size=512)
+        xf = x.reshape(len(x), -1).astype(np.float32)
+        xf -= xf.mean(axis=1, keepdims=True)
+        xf /= np.linalg.norm(xf, axis=1, keepdims=True) + 1e-8
+        same, diff = [], []
+        for i in range(0, 200, 2):
+            for j in range(1, 200, 7):
+                c = float(xf[i] @ xf[j])
+                (same if y[i] == y[j] else diff).append(c)
+        assert np.mean(same) > np.mean(diff) + 0.05
+
+
+class TestPipeline:
+    def test_normalize(self):
+        img = np.full((2, 32, 32, 3), 255, np.uint8)
+        out = np.asarray(normalize_images(jnp.asarray(img), CIFAR10_MEAN, CIFAR10_STD))
+        np.testing.assert_allclose(out[0, 0, 0], (1.0 - CIFAR10_MEAN) / CIFAR10_STD, rtol=1e-5)
+
+    def test_augment_shapes_and_determinism(self):
+        imgs = jnp.asarray(np.random.default_rng(0).normal(0, 1, (4, 32, 32, 3)),
+                           jnp.float32)
+        a = augment_batch(jax.random.key(0), imgs)
+        b = augment_batch(jax.random.key(0), imgs)
+        assert a.shape == imgs.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = augment_batch(jax.random.key(1), imgs)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_cutout(self):
+        imgs = jnp.ones((2, 32, 32, 3), jnp.float32)
+        out = augment_batch(jax.random.key(0), imgs, pad=0, use_cutout=True)
+        # Some pixels must be zeroed by the cutout square.
+        assert float(jnp.sum(out == 0)) > 0
+
+    def test_index_carrying_contract(self):
+        """Batches carry global sample ids (cifar10/datasets.py:93)."""
+        train, test, info = load_dataset("synthetic", synthetic_train_size=64,
+                                         synthetic_test_size=16)
+        shards = [np.arange(32), np.arange(32, 64)]
+        ds = make_sharded_dataset(train, test, shards, info["mean"], info["std"], 10)
+        batch = ds.gather_batch(jnp.asarray([5, 40, 63]))
+        np.testing.assert_array_equal(np.asarray(batch.index), [5, 40, 63])
+        np.testing.assert_array_equal(np.asarray(batch.label),
+                                      train[1][np.array([5, 40, 63])])
+
+    def test_shard_tiling(self):
+        """Unequal shards are cyclically tiled to the max length."""
+        train, test, info = load_dataset("synthetic", synthetic_train_size=64,
+                                         synthetic_test_size=16)
+        shards = [np.arange(10), np.arange(10, 64)]
+        ds = make_sharded_dataset(train, test, shards, info["mean"], info["std"], 10)
+        assert ds.shard_indices.shape == (2, 54)
+        row0 = np.asarray(ds.shard_indices[0])
+        np.testing.assert_array_equal(row0[:10], np.arange(10))
+        np.testing.assert_array_equal(row0[10:20], np.arange(10))  # wrapped
+        assert int(ds.shard_sizes[0]) == 10
+
+    def test_stream_wraps_and_reshuffles(self):
+        stream = init_shard_streams(jax.random.key(0), 1, 10)
+        s = ShardStream(perm=stream.perm[0], cursor=stream.cursor[0])
+        first_epoch = []
+        s1, slots1 = next_pool(s, jax.random.key(1), 6)
+        first_epoch.extend(np.asarray(slots1))
+        # Next pull of 6 exceeds the remaining 4 → reshuffle + restart
+        # (Trainer.get_next wrapping, pytorch_collab.py:74-82).
+        s2, slots2 = next_pool(s1, jax.random.key(2), 6)
+        assert int(s2.cursor) == 6
+        assert len(np.unique(np.asarray(slots2))) == 6  # without replacement
+
+    def test_stream_epoch_covers_all(self):
+        stream = init_shard_streams(jax.random.key(0), 1, 12)
+        s = ShardStream(perm=stream.perm[0], cursor=stream.cursor[0])
+        seen = []
+        for i in range(3):
+            s, slots = next_pool(s, jax.random.key(i + 10), 4)
+            seen.extend(np.asarray(slots))
+        assert sorted(seen) == list(range(12))  # one full epoch, no repeats
+
+    def test_eval_batches_cover_with_mask(self):
+        plan = eval_batches(10, 4)
+        assert len(plan) == 3
+        assert plan[-1][1] == 2  # last batch valid count
+        covered = sorted(set(int(i) for idx, valid in plan for i in idx[:valid]))
+        assert covered == list(range(10))

@@ -1,0 +1,149 @@
+"""Unit tests for the importance-sampling core (SURVEY.md §4: IS scoring,
+EMA, unbiasedness of E[loss/(N·p)])."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mercury_tpu.sampling import (
+    EMAState,
+    draw,
+    draw_with_replacement,
+    ema_update,
+    importance_probs,
+    init_ema,
+    init_groupwise,
+    per_sample_loss,
+    reweighted_loss,
+    select_from_pool,
+    uniform_selection,
+    update_importance,
+    window_indices,
+)
+
+
+class TestEMA:
+    def test_bootstrap_first_update(self):
+        # First update sets the raw value (util.py:209-211).
+        ema = ema_update(init_ema(), jnp.asarray(3.0), alpha=0.9)
+        assert float(ema.value) == pytest.approx(3.0)
+        assert int(ema.count) == 1
+
+    def test_blend(self):
+        ema = ema_update(init_ema(), jnp.asarray(2.0), alpha=0.9)
+        ema = ema_update(ema, jnp.asarray(4.0), alpha=0.9)
+        assert float(ema.value) == pytest.approx(0.9 * 2.0 + 0.1 * 4.0)
+
+
+class TestPerSampleLoss:
+    def test_matches_manual_ce(self):
+        logits = jnp.asarray([[2.0, 0.5, -1.0], [0.0, 0.0, 0.0]])
+        labels = jnp.asarray([0, 2])
+        losses = per_sample_loss(logits, labels)
+        expected = -jax.nn.log_softmax(logits)[jnp.arange(2), labels]
+        np.testing.assert_allclose(np.asarray(losses), np.asarray(expected), rtol=1e-6)
+
+    def test_shape_is_per_sample(self):
+        losses = per_sample_loss(jnp.zeros((7, 10)), jnp.zeros(7, jnp.int32))
+        assert losses.shape == (7,)
+
+
+class TestImportanceProbs:
+    def test_normalized_distribution(self):
+        losses = jnp.asarray([1.0, 2.0, 3.0])
+        p = importance_probs(losses, jnp.asarray(2.0), alpha=0.5)
+        assert float(jnp.sum(p)) == pytest.approx(1.0)
+        # score_i = loss_i + 0.5·EMA (pytorch_collab.py:111-112)
+        scores = np.array([2.0, 3.0, 4.0])
+        np.testing.assert_allclose(np.asarray(p), scores / scores.sum(), rtol=1e-6)
+
+    def test_hard_samples_more_likely(self):
+        p = importance_probs(jnp.asarray([0.1, 5.0]), jnp.asarray(1.0), 0.5)
+        assert float(p[1]) > float(p[0])
+
+
+class TestDrawWithReplacement:
+    def test_empirical_frequency_matches_probs(self):
+        probs = jnp.asarray([0.7, 0.2, 0.1])
+        idx = draw_with_replacement(jax.random.key(0), probs, 20000)
+        freq = np.bincount(np.asarray(idx), minlength=3) / 20000
+        np.testing.assert_allclose(freq, np.asarray(probs), atol=0.02)
+
+    def test_replacement_allows_duplicates(self):
+        idx = draw_with_replacement(jax.random.key(1), jnp.asarray([0.99, 0.01]), 50)
+        assert len(np.unique(np.asarray(idx))) < 50  # dominated by index 0
+
+
+class TestUnbiasedness:
+    def test_is_estimator_unbiased(self):
+        """E[mean(loss_i/(N·p_i))] over IS draws equals the uniform mean loss —
+        the core Mercury estimator property (pytorch_collab.py:116,137)."""
+        rng = np.random.default_rng(0)
+        losses = jnp.asarray(rng.exponential(1.0, 64).astype(np.float32))
+        n = losses.shape[0]
+        probs = importance_probs(losses, jnp.asarray(1.0), 0.5)
+        estimates = []
+        for s in range(400):
+            sel = draw_with_replacement(jax.random.key(s), probs, 16)
+            scaled = probs[sel] * n
+            estimates.append(float(reweighted_loss(losses[sel], scaled)))
+        assert np.mean(estimates) == pytest.approx(float(jnp.mean(losses)), rel=0.05)
+
+    def test_uniform_selection_unit_weights(self):
+        sel, w = uniform_selection(jax.random.key(0), 100, 8)
+        np.testing.assert_array_equal(np.asarray(w), np.ones(8, np.float32))
+        assert np.asarray(sel).min() >= 0 and np.asarray(sel).max() < 100
+
+
+class TestSelectFromPool:
+    def test_full_selection_step(self):
+        key = jax.random.key(0)
+        losses = jnp.asarray(np.random.default_rng(0).exponential(1.0, 320).astype(np.float32))
+        res = select_from_pool(key, losses, init_ema(), 32, 0.5, 0.9)
+        assert res.selected.shape == (32,)
+        assert res.scaled_probs.shape == (32,)
+        # First step: EMA bootstraps to the pool mean.
+        assert float(res.ema.value) == pytest.approx(float(jnp.mean(losses)), rel=1e-5)
+        assert float(res.avg_pool_loss) == pytest.approx(float(jnp.mean(losses)), rel=1e-5)
+        # scaled = p·N, and Σp over the whole pool is 1 → mean of p·N over
+        # the *pool* is 1 (selected entries are biased high — that's the point).
+        probs = importance_probs(losses, res.ema.value, 0.5)
+        np.testing.assert_allclose(
+            np.asarray(res.scaled_probs), np.asarray(probs[res.selected] * 320), rtol=1e-5
+        )
+
+    def test_deterministic_given_key(self):
+        losses = jnp.linspace(0.1, 2.0, 64)
+        r1 = select_from_pool(jax.random.key(7), losses, init_ema(), 8)
+        r2 = select_from_pool(jax.random.key(7), losses, init_ema(), 8)
+        np.testing.assert_array_equal(np.asarray(r1.selected), np.asarray(r2.selected))
+
+
+class TestGroupwise:
+    def test_window_wraps(self):
+        state = init_groupwise(10)
+        idx = window_indices(state, 4)
+        np.testing.assert_array_equal(np.asarray(idx), [0, 1, 2, 3])
+        state = update_importance(state, idx, jnp.ones(4))
+        idx2 = window_indices(state, 8)
+        np.testing.assert_array_equal(np.asarray(idx2), [4, 5, 6, 7, 8, 9, 0, 1])
+
+    def test_draws_only_from_current_group(self):
+        state = init_groupwise(20)
+        idx = window_indices(state, 5)  # samples 0..4 → generation 1
+        state = update_importance(state, idx, jnp.asarray([1.0, 2.0, 3.0, 4.0, 5.0]))
+        sel, scaled = draw(state, jax.random.key(0), 100)
+        assert np.asarray(sel).max() < 5  # only generation-1 samples drawable
+        assert scaled.shape == (100,)
+
+    def test_group_probs_shifted_by_mean(self):
+        # p ∝ importance + mean(importance) over the group (util.py:144-147).
+        state = init_groupwise(4)
+        idx = window_indices(state, 4)
+        imp = jnp.asarray([1.0, 1.0, 1.0, 5.0])
+        state = update_importance(state, idx, imp)
+        sel, _ = draw(state, jax.random.key(0), 40000)
+        freq = np.bincount(np.asarray(sel), minlength=4) / 40000
+        scores = np.asarray(imp) + np.asarray(imp).mean()
+        np.testing.assert_allclose(freq, scores / scores.sum(), atol=0.02)

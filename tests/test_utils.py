@@ -1,0 +1,89 @@
+"""Utils tests: meters (util.py:183-238), flatten/unflatten (util.py:12-63),
+stochastic quantization (util.py:65-70)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mercury_tpu.utils import (
+    Accuracy,
+    Average,
+    EMAverage,
+    flatten_arrays,
+    stochastic_quantize,
+    tree_flatten_to_vector,
+    unflatten_arrays,
+)
+from mercury_tpu.utils.quantize import sparsity
+from mercury_tpu.utils.tree import global_norm
+
+
+class TestMeters:
+    def test_average_weighted(self):
+        m = Average()
+        m.update(1.0, 2)
+        m.update(4.0, 1)
+        assert m.average == pytest.approx(2.0)
+
+    def test_average_empty(self):
+        assert Average().average == 0.0
+
+    def test_emaverage_bootstrap_then_blend(self):
+        m = EMAverage(alpha=0.9)
+        m.update(10.0)
+        assert m.average == pytest.approx(10.0)
+        m.update(0.0)
+        assert m.average == pytest.approx(9.0)
+
+    def test_accuracy(self):
+        m = Accuracy()
+        logits = np.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+        m.update(logits, np.array([0, 1, 1]))
+        assert m.accuracy == pytest.approx(2 / 3)
+
+
+class TestFlatten:
+    def test_roundtrip_tree(self):
+        tree = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones((4,))}
+        vec, unravel = tree_flatten_to_vector(tree)
+        assert vec.shape == (10,)
+        back = unravel(vec)
+        np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(tree["w"]))
+        np.testing.assert_array_equal(np.asarray(back["b"]), np.asarray(tree["b"]))
+
+    def test_roundtrip_list(self):
+        arrays = [jnp.ones((2, 2)), jnp.zeros((3,))]
+        vec = flatten_arrays(arrays)
+        assert vec.shape == (7,)
+        back = unflatten_arrays(vec, arrays)
+        assert back[0].shape == (2, 2) and back[1].shape == (3,)
+
+    def test_unflatten_size_mismatch_raises(self):
+        # Exact-consumption check (util.py:43,62).
+        with pytest.raises(ValueError):
+            unflatten_arrays(jnp.zeros(5), [jnp.zeros((2, 2))])
+
+    def test_global_norm(self):
+        tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+        assert float(global_norm(tree)) == pytest.approx(5.0)
+
+
+class TestQuantize:
+    def test_unbiased_in_expectation(self):
+        a = jnp.asarray([0.5, -1.0, 2.0, 0.0])
+        keys = jax.random.split(jax.random.key(0), 3000)
+        qs = jax.vmap(lambda k: stochastic_quantize(k, a))(keys)
+        np.testing.assert_allclose(np.asarray(qs.mean(0)), np.asarray(a), atol=0.1)
+
+    def test_values_are_sign_max_or_zero(self):
+        a = jnp.asarray([0.5, -1.0, 2.0])
+        q = np.asarray(stochastic_quantize(jax.random.key(1), a))
+        assert set(np.round(np.abs(q), 5)) <= {0.0, 2.0}
+
+    def test_all_zero_tensor(self):
+        q = stochastic_quantize(jax.random.key(0), jnp.zeros(4))
+        np.testing.assert_array_equal(np.asarray(q), np.zeros(4))
+
+    def test_sparsity(self):
+        assert float(sparsity(jnp.asarray([0.0, 1.0, 0.0, 2.0]))) == pytest.approx(0.5)
