@@ -1,0 +1,8 @@
+"""``python -m mercury_tpu`` — the launch entry point (replaces ``python
+pytorch_collab.py``, ``pytorch_collab.py:279-292``)."""
+
+import sys
+
+from mercury_tpu.cli import main
+
+sys.exit(main())

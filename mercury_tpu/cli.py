@@ -1,0 +1,107 @@
+"""Command-line entry point.
+
+The reference has no CLI: its config is module-level globals edited in
+source (``pytorch_collab.py:21-33``) and launch is ``python
+pytorch_collab.py`` forking ``world_size`` gloo processes (``:279-292``,
+hardcoded master addr/port — including the invalid port 295001 noted in
+SURVEY.md "known defects"). Here every :class:`TrainConfig` field is a flag,
+launch is single-controller (``python -m mercury_tpu``), and multi-host
+initialization is one flag (``--distributed``; see
+``mercury_tpu.parallel.distributed``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Optional, Sequence
+
+from mercury_tpu.config import TrainConfig
+
+
+def _add_config_flags(parser: argparse.ArgumentParser) -> None:
+    """Generate one flag per TrainConfig field (source of truth: the
+    dataclass — no drift)."""
+    for field in dataclasses.fields(TrainConfig):
+        name = "--" + field.name.replace("_", "-")
+        default = field.default
+        ftype = field.type
+        if ftype == "bool" or isinstance(default, bool):
+            parser.add_argument(
+                name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                default=default, metavar="BOOL",
+                help=f"(default: {default})",
+            )
+        elif isinstance(default, int) and not isinstance(default, bool):
+            parser.add_argument(name, type=int, default=default,
+                                help=f"(default: {default})")
+        elif isinstance(default, float):
+            parser.add_argument(name, type=float, default=default,
+                                help=f"(default: {default})")
+        else:  # str / Optional[str] / Optional[int]
+            parser.add_argument(name, type=str, default=default,
+                                help=f"(default: {default})")
+
+
+def parse_config(argv: Optional[Sequence[str]] = None) -> tuple[TrainConfig, argparse.Namespace]:
+    parser = argparse.ArgumentParser(
+        prog="mercury_tpu",
+        description="TPU-native importance-sampled distributed training",
+    )
+    _add_config_flags(parser)
+    parser.add_argument("--distributed", action="store_true",
+                        help="initialize jax.distributed for multi-host pods")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="build everything, run one step, print metrics, exit")
+    parser.add_argument("--print-config", action="store_true",
+                        help="print the resolved config as JSON and exit")
+    args = parser.parse_args(argv)
+
+    fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    kw = {}
+    for name in fields:
+        value = getattr(args, name)
+        # Optional[int] fields arrive as strings from argparse; coerce.
+        if isinstance(value, str) and value.isdigit():
+            f = next(f for f in dataclasses.fields(TrainConfig) if f.name == name)
+            if "int" in str(f.type):
+                value = int(value)
+        if isinstance(value, str) and value.lower() in ("none", ""):
+            value = None
+        kw[name] = value
+    return TrainConfig(**kw), args
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    config, args = parse_config(argv)
+    if args.print_config:
+        print(json.dumps(dataclasses.asdict(config), indent=2, default=str))
+        return 0
+
+    if args.distributed:
+        from mercury_tpu.parallel.distributed import initialize
+
+        initialize()
+
+    from mercury_tpu.train.trainer import Trainer
+
+    trainer = Trainer(config)
+    print(f"run: {config.run_name()}  mesh: {trainer.mesh.shape}  "
+          f"steps/epoch: {trainer.steps_per_epoch}")
+    if args.dry_run:
+        state, metrics = trainer.train_step(
+            trainer.state, trainer.dataset.x_train, trainer.dataset.y_train,
+            trainer.dataset.shard_indices,
+        )
+        trainer.state = state
+        print(json.dumps({k: float(v) for k, v in metrics.items()}))
+        return 0
+    final = trainer.fit()
+    print(json.dumps(final))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+"""CLI + config tests: every TrainConfig field is a flag; dry-run executes
+one real step (replacing the reference's edit-source config,
+``pytorch_collab.py:21-33``)."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from mercury_tpu.cli import main, parse_config
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.parallel.distributed import host_worker_slice, process_info
+from mercury_tpu.parallel.mesh import host_cpu_mesh
+
+
+class TestParseConfig:
+    def test_defaults_roundtrip(self):
+        config, _ = parse_config([])
+        assert config == TrainConfig()
+
+    def test_every_field_has_a_flag(self):
+        config, _ = parse_config(
+            ["--model", "vgg11", "--world-size", "2", "--base-lr", "0.01",
+             "--noniid", "false", "--steps-per-epoch", "7"]
+        )
+        assert config.model == "vgg11"
+        assert config.world_size == 2
+        assert config.base_lr == 0.01
+        assert config.noniid is False
+        assert config.steps_per_epoch == 7
+
+    def test_lr_linear_scaling(self):
+        # lr = base_lr × world_size (pytorch_collab.py:28)
+        config, _ = parse_config(["--world-size", "8"])
+        assert config.lr == pytest.approx(0.008)
+
+    def test_run_name_encodes_config(self):
+        config, _ = parse_config(["--model", "resnet50", "--seed", "7"])
+        name = config.run_name()
+        assert "resnet50" in name and "seed7" in name
+
+    def test_print_config_json(self, capsys):
+        rc = main(["--print-config"])
+        assert rc == 0
+        data = json.loads(capsys.readouterr().out)
+        assert set(data) == {f.name for f in dataclasses.fields(TrainConfig)}
+
+
+class TestDryRun:
+    def test_dry_run_executes_one_step(self, capsys):
+        rc = main([
+            "--model", "smallcnn", "--dataset", "synthetic",
+            "--world-size", "8", "--batch-size", "4",
+            "--presample-batches", "2", "--compute-dtype", "float32",
+            "--dry-run",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        metrics = json.loads(out[-1])
+        assert np.isfinite(metrics["train/loss"])
+
+
+class TestDistributedHelpers:
+    def test_process_info_single_host(self):
+        idx, count = process_info()
+        assert idx == 0 and count == 1
+
+    def test_host_worker_slice_covers_all_on_single_host(self):
+        mesh = host_cpu_mesh(8)
+        workers = host_worker_slice(mesh)
+        np.testing.assert_array_equal(workers, np.arange(8))
