@@ -49,6 +49,15 @@ class TrainConfig:
     ema_alpha: float = 0.9           # EMA smoothing factor (util.py:202)
     sync_importance_stats: bool = True  # north-star: psum (sum_loss, count) across workers
 
+    # Augmentation ----------------------------------------------------------
+    # "noniid": pad-4 random crop + hflip (the live hetero pipeline,
+    #   cifar10/data_loader.py:83-96);
+    # "iid": resize(35)→crop(32)→hflip→random affine (exp_dataset.py:25-32);
+    # "none": normalize only.
+    augmentation: str = "noniid"
+    cutout: bool = False             # Cutout(16) — defined-but-unused in the
+                                     # reference (data_loader.py:57-76); opt-in here
+
     # Non-IID partition -----------------------------------------------------
     noniid: bool = True
     dirichlet_alpha: float = 0.5     # pytorch_collab.py:21

@@ -1,7 +1,15 @@
 from mercury_tpu.data.cifar import load_dataset  # noqa: F401
+from mercury_tpu.data.imagefolder import load_image_folder, pil_to_numpy  # noqa: F401
 from mercury_tpu.data.partition import (  # noqa: F401
+    load_partition,
     partition_data,
     record_class_histograms,
+    save_partition,
+)
+from mercury_tpu.data.transforms import (  # noqa: F401
+    augment_batch_iid,
+    eval_transform_iid,
+    truncate_channels,
 )
 from mercury_tpu.data.pipeline import (  # noqa: F401
     Batch,

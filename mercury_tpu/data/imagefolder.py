@@ -1,0 +1,74 @@
+"""Directory-of-images ingest with the index-carrying contract.
+
+Capability parity with ``SampleImageFolder`` (``util.py:162-181`` — an
+``ImageFolder`` whose ``__getitem__`` returns ``(index, sample, target)``
+so non-CIFAR image datasets plug into the importance sampler) and the image
+loading backends ``pil_loader``/``default_loader``
+(``cifar10/datasets.py:15-36``) and the ``ToNumpy`` transform
+(``util.py:73-91``).
+
+TPU-first shape: instead of a lazy per-item loader feeding host worker
+processes, the whole folder is decoded once into device-ready arrays
+(images resized to a uniform square), after which batching is the same
+in-graph gather as CIFAR — the index column is implicit in array order.
+PIL is an optional dependency; importing this module without it raises
+only when used.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def pil_to_numpy(img) -> np.ndarray:
+    """PIL image → HWC uint8 array (``ToNumpy``, ``util.py:73-91``)."""
+    img = img.convert("RGB")
+    return np.asarray(img, dtype=np.uint8)
+
+
+def _load_image(path: str, size: Optional[int]) -> np.ndarray:
+    from PIL import Image  # optional dependency (pil_loader, datasets.py:22-27)
+
+    with Image.open(path) as img:
+        if size is not None:
+            img = img.resize((size, size))
+        return pil_to_numpy(img)
+
+
+def find_classes(root: str) -> List[str]:
+    """Sorted class-subdirectory names (ImageFolder convention)."""
+    return sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+    )
+
+
+def load_image_folder(
+    root: str, image_size: Optional[int] = 32
+) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+    """Decode ``root/<class>/<image>`` into ``(images, labels, class_names)``.
+
+    Images are uint8 NHWC (resized to ``image_size`` square when given);
+    labels are int32 class indices; sample order (= the global index the
+    sampler attributes scores to) is deterministic: classes sorted, files
+    sorted within class — the stable analogue of the reference's
+    index-carrying ``(index, sample, target)`` tuples (``util.py:165-181``).
+    """
+    classes = find_classes(root)
+    if not classes:
+        raise FileNotFoundError(f"no class subdirectories under {root!r}")
+    images, labels = [], []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fname in sorted(os.listdir(cdir)):
+            if os.path.splitext(fname)[1].lower() in IMG_EXTENSIONS:
+                images.append(_load_image(os.path.join(cdir, fname), image_size))
+                labels.append(label)
+    if not images:
+        raise FileNotFoundError(f"no images with {IMG_EXTENSIONS} under {root!r}")
+    return np.stack(images), np.asarray(labels, np.int32), classes

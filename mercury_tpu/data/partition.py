@@ -78,12 +78,15 @@ def partition_data(
     alpha: float = 0.5,
     seed: int = 102,
     min_size: int = 10,
+    partition_file: str = None,
 ) -> List[np.ndarray]:
     """Dispatch matching ``partition_data`` (``cifar10/data_loader.py:126``).
 
-    ``mode``: ``"homo"`` (IID) or ``"hetero"`` (Dirichlet non-IID). Returns a
-    list of sorted global-index arrays, one per worker; shards are disjoint
-    and cover the dataset.
+    ``mode``: ``"homo"`` (IID, ``:132-136``), ``"hetero"`` (Dirichlet
+    non-IID, ``:138-161``), or ``"hetero-fix"`` (pre-computed partition
+    from ``partition_file``, ``:163-169``). Returns a list of sorted
+    global-index arrays, one per worker; generated shards are disjoint and
+    cover the dataset.
     """
     rng = np.random.default_rng(seed)
     n = int(np.asarray(labels).shape[0])
@@ -91,7 +94,35 @@ def partition_data(
         return partition_homo(n, n_workers, rng)
     if mode == "hetero":
         return partition_dirichlet(labels, n_workers, alpha, rng, min_size=min_size)
-    raise ValueError(f"unknown partition mode {mode!r} (use 'homo' or 'hetero')")
+    if mode == "hetero-fix":
+        if partition_file is None:
+            raise ValueError("mode='hetero-fix' requires partition_file")
+        shards = load_partition(partition_file)
+        if len(shards) != n_workers:
+            raise ValueError(
+                f"partition file has {len(shards)} shards, need {n_workers}"
+            )
+        return shards
+    raise ValueError(
+        f"unknown partition mode {mode!r} (use 'homo', 'hetero', or 'hetero-fix')"
+    )
+
+
+def save_partition(path: str, shards: List[np.ndarray]) -> None:
+    """Persist a partition to an ``.npz`` for the fixed-partition workflow
+    (the reference's ``hetero-fix`` mode reads pre-computed per-client
+    index maps from files, ``cifar10/data_loader.py:16-43,163-169`` — the
+    files themselves are absent from the repo, so the format here is our
+    own, with a writer so it is actually usable)."""
+    np.savez(path, **{f"worker_{i}": np.asarray(s, np.int64) for i, s in enumerate(shards)})
+
+
+def load_partition(path: str) -> List[np.ndarray]:
+    """Inverse of :func:`save_partition` (``hetero-fix`` read path,
+    ``cifar10/data_loader.py:163-169``)."""
+    with np.load(path) as data:
+        keys = sorted(data.files, key=lambda k: int(k.split("_")[1]))
+        return [data[k].astype(np.int64) for k in keys]
 
 
 def record_class_histograms(

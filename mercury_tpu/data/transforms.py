@@ -1,0 +1,126 @@
+"""Extended on-device transforms: the reference's IID-path augmentation and
+eval transforms, plus channel truncation.
+
+Capability parity with ``load_cifar10``'s IID pipeline (``exp_dataset.py:
+23-77``): train transform ``Resize(35) → RandomCrop(32) → HFlip →
+RandomAffine(±10°, scale 0.9-1.1)`` (``:25-32``) and test transform
+``Resize(33) → RandomCrop(32)`` (``:63-68``); and with
+``CIFAR10_truncated.truncate_channel`` (``cifar10/datasets.py:71-75``) —
+zeroing the G/B channels of selected samples.
+
+All transforms are pure ``jax.random`` functions vmapped per sample, so
+they fuse into the train step like the non-IID pipeline in
+``mercury_tpu.data.pipeline``. The affine warp is inverse-mapped bilinear
+resampling (``jax.scipy.ndimage.map_coordinates``) — the array-native
+equivalent of torchvision's ``RandomAffine``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.ndimage import map_coordinates
+
+from mercury_tpu.data.pipeline import _hflip_one, _random_crop_one
+
+
+def resize_batch(images: jax.Array, size: int) -> jax.Array:
+    """Bilinear resize to ``size×size`` (``transforms.Resize``)."""
+    n, _, _, c = images.shape
+    return jax.image.resize(images, (n, size, size, c), method="bilinear")
+
+
+def _crop_to(key: jax.Array, img: jax.Array, out: int) -> jax.Array:
+    """Random crop of an ``H×W`` image down to ``out×out`` (RandomCrop with
+    no padding — the IID path crops a larger resized image,
+    ``exp_dataset.py:26-27,64-68``)."""
+    h, w, c = img.shape
+    oy = jax.random.randint(key, (), 0, h - out + 1)
+    ox = jax.random.randint(jax.random.fold_in(key, 1), (), 0, w - out + 1)
+    return jax.lax.dynamic_slice(img, (oy, ox, 0), (out, out, c))
+
+
+def _affine_one(
+    key: jax.Array,
+    img: jax.Array,
+    max_rotate_deg: float,
+    scale_min: float,
+    scale_max: float,
+) -> jax.Array:
+    """Random rotation + isotropic scale about the image center
+    (``RandomAffine(10, scale=(0.9, 1.1))``, ``exp_dataset.py:29-31``).
+
+    Output pixel (y, x) samples the input at the inverse-transformed
+    location; out-of-bounds reads clamp to the edge (order-1 bilinear).
+    """
+    h, w, _ = img.shape
+    k1, k2 = jax.random.split(key)
+    theta = jnp.deg2rad(
+        jax.random.uniform(k1, (), minval=-max_rotate_deg, maxval=max_rotate_deg)
+    )
+    scale = jax.random.uniform(k2, (), minval=scale_min, maxval=scale_max)
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    yc, xc = ys - cy, xs - cx
+    # Inverse map: rotate by -θ, scale by 1/s.
+    cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+    inv = 1.0 / scale
+    src_y = (cos_t * yc + sin_t * xc) * inv + cy
+    src_x = (-sin_t * yc + cos_t * xc) * inv + cx
+    coords = jnp.stack([src_y, src_x])
+
+    def warp_channel(ch):
+        return map_coordinates(ch, coords, order=1, mode="nearest")
+
+    return jnp.stack([warp_channel(img[..., c]) for c in range(img.shape[-1])],
+                     axis=-1)
+
+
+def augment_batch_iid(
+    key: jax.Array,
+    images: jax.Array,
+    resize_to: int = 35,
+    crop_to: int = 32,
+    max_rotate_deg: float = 10.0,
+    scale_range: tuple = (0.9, 1.1),
+) -> jax.Array:
+    """The IID-path train augmentation (``exp_dataset.py:25-32``):
+    resize → random crop → hflip → random affine."""
+    k_crop, k_flip, k_aff = jax.random.split(key, 3)
+    n = images.shape[0]
+    out = resize_batch(images, resize_to)
+    out = jax.vmap(_crop_to, in_axes=(0, 0, None))(
+        jax.random.split(k_crop, n), out, crop_to
+    )
+    out = jax.vmap(_hflip_one)(jax.random.split(k_flip, n), out)
+    out = jax.vmap(_affine_one, in_axes=(0, 0, None, None, None))(
+        jax.random.split(k_aff, n), out, max_rotate_deg,
+        scale_range[0], scale_range[1],
+    )
+    return out
+
+
+def eval_transform_iid(
+    key: jax.Array, images: jax.Array, resize_to: int = 33, crop_to: int = 32
+) -> jax.Array:
+    """The IID-path test transform (``exp_dataset.py:63-68``):
+    resize(33) → random crop(32)."""
+    n = images.shape[0]
+    out = resize_batch(images, resize_to)
+    return jax.vmap(_crop_to, in_axes=(0, 0, None))(
+        jax.random.split(key, n), out, crop_to
+    )
+
+
+def truncate_channels(
+    images: jax.Array, sample_mask: jax.Array, keep_channel: int = 0
+) -> jax.Array:
+    """Zero all but ``keep_channel`` for samples where ``sample_mask`` is
+    True (``CIFAR10_truncated.truncate_channel``,
+    ``cifar10/datasets.py:71-75`` — the reference zeroes G and B, keeping
+    R, for a selected index range)."""
+    c = images.shape[-1]
+    ch_keep = (jnp.arange(c) == keep_channel)
+    zeroed = images * ch_keep.astype(images.dtype)
+    return jnp.where(sample_mask[:, None, None, None], zeroed, images)

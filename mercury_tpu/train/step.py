@@ -105,7 +105,14 @@ def make_train_step(
         stream, slots = next_pool(stream, k_stream, pool_size)
         global_idx = shard_indices[0][slots]
         images = normalize_images(x_train[global_idx], mean, std)
-        images = augment_batch(k_aug, images)
+        if config.augmentation == "noniid":
+            images = augment_batch(k_aug, images, use_cutout=config.cutout)
+        elif config.augmentation == "iid":
+            from mercury_tpu.data.transforms import augment_batch_iid
+
+            images = augment_batch_iid(k_aug, images)
+        elif config.augmentation != "none":
+            raise ValueError(f"unknown augmentation {config.augmentation!r}")
         labels = y_train[global_idx]
 
         ema = EMAState(value=state.ema.value[0], count=state.ema.count[0])

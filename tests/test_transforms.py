@@ -1,0 +1,135 @@
+"""Tests for the extended data layer: IID-path transforms
+(exp_dataset.py:25-32,63-68), channel truncation, fixed partitions, and
+ImageFolder ingest."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mercury_tpu.data import (
+    augment_batch_iid,
+    eval_transform_iid,
+    load_image_folder,
+    load_partition,
+    partition_data,
+    pil_to_numpy,
+    save_partition,
+    truncate_channels,
+)
+from mercury_tpu.data.transforms import _affine_one, resize_batch
+
+
+@pytest.fixture(scope="module")
+def images():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.uniform(0, 1, (4, 32, 32, 3)), jnp.float32)
+
+
+class TestIIDAugment:
+    def test_output_shape(self, images):
+        out = augment_batch_iid(jax.random.key(0), images)
+        assert out.shape == images.shape
+
+    def test_deterministic_per_key(self, images):
+        a = augment_batch_iid(jax.random.key(3), images)
+        b = augment_batch_iid(jax.random.key(3), images)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = augment_batch_iid(jax.random.key(4), images)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_eval_transform_shape(self, images):
+        out = eval_transform_iid(jax.random.key(0), images)
+        assert out.shape == images.shape
+
+    def test_resize(self, images):
+        assert resize_batch(images, 35).shape == (4, 35, 35, 3)
+
+    def test_identity_affine_preserves_image(self):
+        """Zero rotation + unit scale must be (nearly) the identity."""
+        img = jnp.asarray(np.random.default_rng(1).uniform(0, 1, (16, 16, 3)),
+                          jnp.float32)
+        out = _affine_one(jax.random.key(0), img, 0.0, 1.0, 1.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(img), atol=1e-5)
+
+    def test_rotation_moves_pixels(self):
+        img = jnp.zeros((16, 16, 1)).at[2, 2, 0].set(1.0)
+        out = _affine_one(jax.random.key(0), img, 45.0, 1.0, 1.0)
+        # Large rotation: corner mass should have moved.
+        assert float(out[2, 2, 0]) < 0.99
+
+    def test_jit_compatible(self, images):
+        jitted = jax.jit(augment_batch_iid)
+        out = jitted(jax.random.key(0), images)
+        assert out.shape == images.shape
+
+
+class TestTruncateChannels:
+    def test_masks_selected_samples_only(self, images):
+        mask = jnp.asarray([True, False, True, False])
+        out = truncate_channels(images, mask, keep_channel=0)
+        # Selected: G/B zeroed, R kept (cifar10/datasets.py:71-75).
+        np.testing.assert_array_equal(np.asarray(out[0, ..., 1:]), 0.0)
+        np.testing.assert_array_equal(np.asarray(out[0, ..., 0]),
+                                      np.asarray(images[0, ..., 0]))
+        # Unselected: untouched.
+        np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(images[1]))
+
+
+class TestFixedPartition:
+    def test_save_load_roundtrip(self, tmp_path):
+        shards = [np.arange(10), np.arange(10, 30), np.arange(30, 35)]
+        path = str(tmp_path / "part.npz")
+        save_partition(path, shards)
+        back = load_partition(path)
+        assert len(back) == 3
+        for a, b in zip(shards, back):
+            np.testing.assert_array_equal(a, b)
+
+    def test_hetero_fix_mode(self, tmp_path):
+        labels = np.zeros(35, np.int32)
+        shards = [np.arange(10), np.arange(10, 35)]
+        path = str(tmp_path / "part.npz")
+        save_partition(path, shards)
+        out = partition_data(labels, 2, mode="hetero-fix", partition_file=path)
+        np.testing.assert_array_equal(out[0], shards[0])
+
+    def test_hetero_fix_requires_file(self):
+        with pytest.raises(ValueError, match="partition_file"):
+            partition_data(np.zeros(10, np.int32), 2, mode="hetero-fix")
+
+    def test_hetero_fix_worker_mismatch(self, tmp_path):
+        path = str(tmp_path / "part.npz")
+        save_partition(path, [np.arange(5), np.arange(5, 10)])
+        with pytest.raises(ValueError, match="shards"):
+            partition_data(np.zeros(10, np.int32), 4, mode="hetero-fix",
+                           partition_file=path)
+
+
+class TestImageFolder:
+    def test_loads_class_dirs(self, tmp_path):
+        from PIL import Image
+
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                Image.fromarray(
+                    np.full((8, 8, 3), 40 * i, np.uint8)
+                ).save(d / f"img_{i}.png")
+        images, labels, classes = load_image_folder(str(tmp_path), image_size=16)
+        assert images.shape == (6, 16, 16, 3)
+        assert classes == ["cat", "dog"]
+        np.testing.assert_array_equal(labels, [0, 0, 0, 1, 1, 1])
+
+    def test_empty_dir_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_image_folder(str(tmp_path))
+
+    def test_pil_to_numpy(self):
+        from PIL import Image
+
+        arr = pil_to_numpy(Image.fromarray(np.ones((4, 4, 3), np.uint8)))
+        assert arr.shape == (4, 4, 3) and arr.dtype == np.uint8
