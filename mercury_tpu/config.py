@@ -80,6 +80,11 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"  # MXU-friendly activations/matmuls
     param_dtype: str = "float32"
 
+    # Kernels ---------------------------------------------------------------
+    # None → auto (Pallas kernels on TPU, jax-native elsewhere);
+    # True/False force. Pallas path requires label_smoothing == 0.
+    use_pallas: Optional[bool] = None
+
     @property
     def lr(self) -> float:
         """Linear-scaling rule: base_lr × world_size (pytorch_collab.py:28)."""

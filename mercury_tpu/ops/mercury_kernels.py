@@ -1,0 +1,207 @@
+"""Pallas TPU kernels for the Mercury hot ops.
+
+Two kernels cover the importance-sampling inner loop (the math of
+``Trainer.update_samples``, ``pytorch_collab.py:101-117``):
+
+1. :func:`per_sample_nll_pallas` — fused per-sample cross-entropy
+   (log-softmax + label gather in one VMEM pass, ≡ ``F.cross_entropy(...,
+   reduction='none')`` at ``:102,:133``), with a custom VJP
+   (``softmax − onehot`` per sample) so it serves both the scoring pass and
+   the differentiable training loss.
+2. :func:`score_and_draw_pallas` — fused score smoothing → normalization →
+   inverse-CDF categorical draws → ``p·N`` gather (≡ ``:111-116``), one
+   VMEM-resident kernel: the cumulative distribution never round-trips to
+   HBM.
+
+Uniform variates are passed in (from ``jax.random``) rather than drawn with
+the in-kernel TPU PRNG, so the draw is reproducible from a JAX key and the
+kernels run identically under ``interpret=True`` on CPU (how the test suite
+exercises them without a chip).
+
+Shapes here are small (pool ≤ a few thousand, classes ≤ 1024): each kernel
+is a single block, no grid — Mosaic pads to the (8, 128) f32 tile
+internally. The win is fusion (one HBM read of the logits, everything else
+in VMEM), not tiling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def on_tpu() -> bool:
+    """True when the default backend is a real TPU (kernels compile via
+    Mosaic); otherwise wrappers run in interpret mode."""
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+# ----------------------------------------------------------------- kernel 1
+def _nll_kernel(logits_ref, labels_ref, nll_ref):
+    """Fused log-softmax + one-hot gather: nll_i = lse(logits_i) − logits_i[y_i]."""
+    logits = logits_ref[:].astype(jnp.float32)          # [N, C]
+    m = jnp.max(logits, axis=1, keepdims=True)
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=1, keepdims=True)) + m  # [N, 1]
+    n, c = logits.shape
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, c), 1) == labels_ref[:]
+    ).astype(jnp.float32)                                # labels_ref: [N, 1]
+    picked = jnp.sum(logits * onehot, axis=1, keepdims=True)  # [N, 1]
+    nll_ref[:] = lse - picked
+
+
+def _nll_fwd_raw(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    n, _ = logits.shape
+    return pl.pallas_call(
+        _nll_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(logits, labels.reshape(-1, 1).astype(jnp.int32))[:, 0]
+
+
+def _nll_bwd_kernel(logits_ref, labels_ref, g_ref, grad_ref):
+    """d nll_i / d logits_i = softmax(logits_i) − onehot(y_i), scaled by g_i."""
+    logits = logits_ref[:].astype(jnp.float32)
+    m = jnp.max(logits, axis=1, keepdims=True)
+    e = jnp.exp(logits - m)
+    softmax = e / jnp.sum(e, axis=1, keepdims=True)
+    n, c = logits.shape
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, c), 1) == labels_ref[:]
+    ).astype(jnp.float32)
+    grad_ref[:] = (softmax - onehot) * g_ref[:]          # g_ref: [N, 1]
+
+
+@jax.custom_vjp
+def per_sample_nll_pallas(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Fused per-sample cross-entropy (``reduction='none'``) as a Pallas
+    kernel. ``logits``: [N, C] (any float dtype), ``labels``: [N] int.
+    Returns fp32 ``[N]`` losses. Differentiable w.r.t. logits."""
+    return _nll_fwd_raw(logits, labels)
+
+
+def _vjp_fwd(logits, labels):
+    return _nll_fwd_raw(logits, labels), (logits, labels)
+
+
+def _vjp_bwd(residual, g):
+    logits, labels = residual
+    n, _ = logits.shape
+    grad = pl.pallas_call(
+        _nll_bwd_kernel,
+        out_shape=jax.ShapeDtypeStruct(logits.shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(logits, labels.reshape(-1, 1).astype(jnp.int32),
+      g.reshape(-1, 1).astype(jnp.float32))
+    return grad.astype(logits.dtype), None
+
+
+per_sample_nll_pallas.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ----------------------------------------------------------------- kernel 2
+def _score_draw_kernel(
+    losses_ref, ema_ref, uniforms_ref,
+    probs_ref, selected_ref, scaled_ref,
+    *, alpha: float,
+):
+    """score → normalize → inverse-CDF draw → p·N gather, all in VMEM.
+
+    ``losses_ref``: [N, 1]; ``ema_ref``: [1, 1] (SMEM); ``uniforms_ref``:
+    [1, B] iid U(0,1). Outputs: normalized probs [N, 1], selected pool
+    positions [1, B] int32, scaled probs p·N [1, B].
+
+    Mosaic notes: ``cumsum`` has no TC lowering, so the CDF is a
+    lower-triangular matmul (MXU); everything is laid out so no in-kernel
+    transpose is needed.
+    """
+    losses = losses_ref[:]                                # [N, 1]
+    n = losses.shape[0]
+    scores = jnp.maximum(losses + alpha * ema_ref[0, 0], 1e-12)  # :111
+    total = jnp.sum(scores)
+    probs = scores / total                                # :112
+    probs_ref[:] = probs
+
+    # CDF via lower-triangular matmul: cdf_j = Σ_{k≤j} p_k.
+    row = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    lower = (col <= row).astype(jnp.float32)              # [N, N]
+    cdf = jnp.dot(lower, probs, preferred_element_type=jnp.float32)  # [N, 1]
+
+    # Inverse-CDF sampling ≡ multinomial-with-replacement (:114):
+    # idx_b = #{ j : cdf_j <= u_b } clamped to N-1.
+    u = uniforms_ref[:]                                   # [1, B]
+    cmp = (cdf <= u).astype(jnp.int32)                    # [N, B] broadcast
+    idx = jnp.minimum(jnp.sum(cmp, axis=0, keepdims=True), n - 1)  # [1, B]
+    selected_ref[:] = idx
+
+    # scaled_b = p[idx_b]·N via one-hot mask-and-reduce (gather-free).
+    b = u.shape[1]
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, b), 0) == idx
+    ).astype(jnp.float32)                                 # [N, B]
+    scaled_ref[:] = jnp.sum(onehot * (probs * n), axis=0, keepdims=True)  # p·N (:116)
+
+
+def score_and_draw_pallas(
+    key: jax.Array,
+    losses: jax.Array,
+    ema_value: jax.Array,
+    batch_size: int,
+    alpha: float = 0.5,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused Mercury selection given per-candidate losses and the (already
+    updated, possibly psum-synced) EMA value.
+
+    Returns ``(probs [N], selected [B] int32, scaled_probs [B])`` matching
+    the jax-native ``importance_probs`` + ``draw_with_replacement`` +
+    ``p·N`` pipeline (``mercury_tpu.sampling.importance``).
+    """
+    n = losses.shape[0]
+    uniforms = jax.random.uniform(key, (1, batch_size), jnp.float32)
+    kernel = functools.partial(_score_draw_kernel, alpha=alpha)
+    probs, selected, scaled = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
+            jax.ShapeDtypeStruct((1, batch_size), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(
+        losses.reshape(-1, 1).astype(jnp.float32),
+        ema_value.reshape(1, 1).astype(jnp.float32),
+        uniforms,
+    )
+    return probs[:, 0], selected[0, :], scaled[0, :]

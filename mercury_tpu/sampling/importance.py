@@ -101,6 +101,19 @@ def reweighted_loss(
     return jnp.mean(losses / scaled_probs)
 
 
+def pool_mean(pool_losses: jax.Array, axis_name: Optional[str] = None) -> jax.Array:
+    """Mean presampling loss; with ``axis_name``, the **global** mean —
+    psum of (sum, count) over the data axis (the north-star cross-worker
+    importance-statistic exchange, SURVEY.md §2.5)."""
+    pool_losses = pool_losses.astype(jnp.float32)
+    n = pool_losses.shape[0]
+    if axis_name is not None:
+        total = jax.lax.psum(jnp.sum(pool_losses), axis_name)
+        count = jax.lax.psum(jnp.asarray(n, jnp.float32), axis_name)
+        return total / count
+    return jnp.mean(pool_losses)
+
+
 class SelectionResult(NamedTuple):
     ema: EMAState
     selected: jax.Array       # [batch] int32 — positions into the candidate pool
@@ -128,12 +141,7 @@ def select_from_pool(
     """
     pool_losses = pool_losses.astype(jnp.float32)
     n = pool_losses.shape[0]
-    if axis_name is not None:
-        total = jax.lax.psum(jnp.sum(pool_losses), axis_name)
-        count = jax.lax.psum(jnp.asarray(n, jnp.float32), axis_name)
-        mean_loss = total / count
-    else:
-        mean_loss = jnp.mean(pool_losses)
+    mean_loss = pool_mean(pool_losses, axis_name)
     new_ema = ema_update(ema, mean_loss, ema_alpha)
     probs = importance_probs(pool_losses, new_ema.value, is_alpha)
     selected = draw_with_replacement(key, probs, batch_size)

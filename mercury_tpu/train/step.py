@@ -78,6 +78,21 @@ def make_train_step(
     batch_size = config.batch_size
     stat_axis = axis if (use_is and config.sync_importance_stats) else None
 
+    use_pallas = config.use_pallas
+    if use_pallas is None:  # auto: Mosaic kernels on real TPU only
+        from mercury_tpu.ops import on_tpu
+
+        use_pallas = on_tpu()
+    if use_pallas and config.label_smoothing != 0.0:
+        raise ValueError("use_pallas requires label_smoothing == 0")
+
+    def _loss_per_sample(logits, labels):
+        if use_pallas:
+            from mercury_tpu.ops import per_sample_nll_pallas
+
+            return per_sample_nll_pallas(logits, labels)
+        return per_sample_loss(logits, labels, config.label_smoothing)
+
     def _apply_train(params, batch_stats, images, keep_stats: bool):
         """Train-mode forward. ``keep_stats=False`` (the scoring pass) uses
         batch statistics for normalization but discards the running-stat
@@ -122,15 +137,28 @@ def make_train_step(
             # pool (≡ the 10-iteration no_grad loop, :95-106), batch-stat
             # normalization, running-stat updates discarded ----------------
             pool_logits, _ = _apply_train(state.params, state.batch_stats, images, False)
-            pool_losses = per_sample_loss(pool_logits, labels)
-            sel = select_from_pool(
-                k_sel, pool_losses, ema, batch_size,
-                is_alpha=config.is_alpha, ema_alpha=config.ema_alpha,
-                axis_name=stat_axis,
-            )
-            selected, scaled_probs = sel.selected, sel.scaled_probs
-            ema = sel.ema
-            avg_pool_loss = sel.avg_pool_loss
+            pool_losses = _loss_per_sample(pool_logits, labels)
+            if use_pallas:
+                # Fused Pallas score→normalize→draw→p·N kernel; EMA update
+                # and the (optional) cross-worker stat psum stay outside —
+                # they are scalars.
+                from mercury_tpu.ops import score_and_draw_pallas
+                from mercury_tpu.sampling.importance import ema_update, pool_mean
+
+                avg_pool_loss = pool_mean(pool_losses, stat_axis)
+                ema = ema_update(ema, avg_pool_loss, config.ema_alpha)
+                _, selected, scaled_probs = score_and_draw_pallas(
+                    k_sel, pool_losses, ema.value, batch_size, config.is_alpha
+                )
+            else:
+                sel = select_from_pool(
+                    k_sel, pool_losses, ema, batch_size,
+                    is_alpha=config.is_alpha, ema_alpha=config.ema_alpha,
+                    axis_name=stat_axis,
+                )
+                selected, scaled_probs = sel.selected, sel.scaled_probs
+                ema = sel.ema
+                avg_pool_loss = sel.avg_pool_loss
         else:
             # Uniform baseline: consume the freshly streamed batch directly —
             # the stream is a shuffled without-replacement epoch pass, i.e.
@@ -147,7 +175,7 @@ def make_train_step(
         # mean(loss_i/(N·p_i)) (:132-148) --------------------------------
         def loss_fn(params):
             logits, new_bs = _apply_train(params, state.batch_stats, sel_images, True)
-            losses = per_sample_loss(logits, sel_labels, config.label_smoothing)
+            losses = _loss_per_sample(logits, sel_labels)
             return reweighted_loss(losses, scaled_probs), (logits, new_bs)
 
         (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(

@@ -95,6 +95,22 @@ class TestConvergence:
             losses.append(float(m["train/loss"]))
         assert np.mean(losses[-5:]) < np.mean(losses[:5])
 
+    def test_pallas_path_matches_convergence(self, mesh):
+        """Forcing the Pallas kernels (interpret mode on CPU) must still
+        train: fused CE + score/draw kernels inside the SPMD step."""
+        cfg = tiny_config(use_pallas=True, steps_per_epoch=10, batch_size=8,
+                          presample_batches=2, world_size=8)
+        tr = Trainer(cfg, mesh=mesh)
+        losses = []
+        for _ in range(10):
+            tr.state, m = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices,
+            )
+            losses.append(float(m["train/loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert np.mean(losses[-3:]) < np.mean(losses[:3]) + 0.5
+
     def test_uniform_control_arm(self, mesh):
         """Uniform-sampling baseline (IS off) also runs and learns."""
         cfg = tiny_config(use_importance_sampling=False, steps_per_epoch=20,
