@@ -44,6 +44,13 @@ class TrainConfig:
 
     # Importance sampling ---------------------------------------------------
     use_importance_sampling: bool = True
+    # "pool": score a fresh candidate pool each step and draw from it
+    #   (the live Trainer.update_samples path, pytorch_collab.py:89-117);
+    # "groupwise": persistent per-sample importance over the whole shard
+    #   with sliding-window refresh + draws from the newest group
+    #   (Groupwise_Sampler, util.py:94-160 — library-only in the reference,
+    #   a first-class strategy here).
+    sampler: str = "pool"
     presample_batches: int = 10      # candidate pool = 10×batch (pytorch_collab.py:95)
     is_alpha: float = 0.5            # score = loss + alpha·EMA (pytorch_collab.py:111)
     ema_alpha: float = 0.9           # EMA smoothing factor (util.py:202)

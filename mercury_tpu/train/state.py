@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import optax
 
 from mercury_tpu.data.pipeline import ShardStream
+from mercury_tpu.sampling.groupwise import GroupwiseState, init_groupwise
 from mercury_tpu.sampling.importance import EMAState, init_ema
 
 
@@ -30,6 +31,7 @@ class MercuryState:
     ema: EMAState                   # [W]-stacked per-worker EMA of mean pool loss
     stream: ShardStream             # [W]-stacked per-worker presample streams
     rng: jax.Array                  # [W, key] per-worker PRNG keys
+    groupwise: Any = None           # [W]-stacked GroupwiseState (sampler="groupwise")
 
 
 def create_state(
@@ -39,6 +41,7 @@ def create_state(
     sample_batch: jax.Array,
     n_workers: int,
     shard_len: int,
+    with_groupwise: bool = False,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -60,6 +63,12 @@ def create_state(
     )
     stream = init_shard_streams(stream_key, n_workers, shard_len)
     worker_keys = jax.random.split(worker_key, n_workers)
+    groupwise = None
+    if with_groupwise:
+        g0 = init_groupwise(shard_len)
+        groupwise = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (n_workers,) + x.shape), g0
+        )
     return MercuryState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -68,6 +77,7 @@ def create_state(
         ema=ema,
         stream=stream,
         rng=worker_keys,
+        groupwise=groupwise,
     )
 
 

@@ -34,7 +34,9 @@ from mercury_tpu.data.pipeline import ShardStream, augment_batch, next_pool, nor
 from mercury_tpu.parallel.collectives import allreduce_mean_tree
 from mercury_tpu.sampling.importance import (
     EMAState,
+    ema_update,
     per_sample_loss,
+    pool_mean,
     reweighted_loss,
     select_from_pool,
 )
@@ -43,7 +45,7 @@ from mercury_tpu.train.state import MercuryState
 from jax import shard_map
 
 
-def _state_specs(axis: str) -> MercuryState:
+def _state_specs(axis: str, has_groupwise: bool = False) -> MercuryState:
     """PartitionSpec pytree-prefix for :class:`MercuryState`: model/opt state
     replicated, per-worker sampler state sharded along the data axis."""
     return MercuryState(
@@ -54,6 +56,7 @@ def _state_specs(axis: str) -> MercuryState:
         ema=EMAState(value=P(axis), count=P(axis)),
         stream=ShardStream(perm=P(axis), cursor=P(axis)),
         rng=P(axis),
+        groupwise=P(axis) if has_groupwise else None,
     )
 
 
@@ -85,6 +88,9 @@ def make_train_step(
         use_pallas = on_tpu()
     if use_pallas and config.label_smoothing != 0.0:
         raise ValueError("use_pallas requires label_smoothing == 0")
+    if config.sampler not in ("pool", "groupwise"):
+        raise ValueError(f"unknown sampler {config.sampler!r}")
+    use_groupwise = use_is and config.sampler == "groupwise"
 
     def _loss_per_sample(logits, labels):
         if use_pallas:
@@ -109,25 +115,41 @@ def make_train_step(
             return logits, new_stats
         return model.apply(variables, images, train=True), batch_stats
 
+    def _augment(key, images):
+        if config.augmentation == "noniid":
+            return augment_batch(key, images, use_cutout=config.cutout)
+        if config.augmentation == "iid":
+            from mercury_tpu.data.transforms import augment_batch_iid
+
+            return augment_batch_iid(key, images)
+        if config.augmentation != "none":
+            raise ValueError(f"unknown augmentation {config.augmentation!r}")
+        return images
+
     def body(state: MercuryState, x_train, y_train, shard_indices):
         # Leading axis inside shard_map is this device's single worker row.
         rng = state.rng[0]
-        k_stream, k_aug, k_sel, k_next = jax.random.split(rng, 4)
+        k_stream, k_aug, k_sel, k_aug2, k_next = jax.random.split(rng, 5)
 
-        # --- presample pool: next `pool_size` samples of this worker's shard
-        # (≡ Trainer.get_next over the presampling loader, :74-82) ----------
+        groupwise = None
         stream = ShardStream(perm=state.stream.perm[0], cursor=state.stream.cursor[0])
-        stream, slots = next_pool(stream, k_stream, pool_size)
-        global_idx = shard_indices[0][slots]
-        images = normalize_images(x_train[global_idx], mean, std)
-        if config.augmentation == "noniid":
-            images = augment_batch(k_aug, images, use_cutout=config.cutout)
-        elif config.augmentation == "iid":
-            from mercury_tpu.data.transforms import augment_batch_iid
+        if use_groupwise:
+            # Sliding-window refresh over the shard (util.py:114-138): the
+            # next `pool_size` slots in order, wrapping — no shuffle.
+            from mercury_tpu.sampling.groupwise import (
+                draw as gw_draw,
+                update_importance,
+                window_indices,
+            )
 
-            images = augment_batch_iid(k_aug, images)
-        elif config.augmentation != "none":
-            raise ValueError(f"unknown augmentation {config.augmentation!r}")
+            groupwise = jax.tree_util.tree_map(lambda x: x[0], state.groupwise)
+            slots = window_indices(groupwise, pool_size)
+        else:
+            # Shuffled wrapping presample stream (≡ Trainer.get_next over
+            # the presampling loader, :74-82).
+            stream, slots = next_pool(stream, k_stream, pool_size)
+        global_idx = shard_indices[0][slots]
+        images = _augment(k_aug, normalize_images(x_train[global_idx], mean, std))
         labels = y_train[global_idx]
 
         ema = EMAState(value=state.ema.value[0], count=state.ema.count[0])
@@ -138,12 +160,27 @@ def make_train_step(
             # normalization, running-stat updates discarded ----------------
             pool_logits, _ = _apply_train(state.params, state.batch_stats, images, False)
             pool_losses = _loss_per_sample(pool_logits, labels)
-            if use_pallas:
+            if use_groupwise:
+                # Persist scores into the shard-wide importance array, tag
+                # the new generation, draw from it with the +mean shift
+                # (util.py:133-153). Drawn slots are re-gathered and
+                # re-augmented (the sampler re-loads by index, as the
+                # reference's does via get_slice, util.py:123).
+                groupwise = update_importance(groupwise, slots, pool_losses)
+                sel_slots, scaled_probs = gw_draw(groupwise, k_sel, batch_size)
+                sel_global = shard_indices[0][sel_slots]
+                sel_images = _augment(
+                    k_aug2, normalize_images(x_train[sel_global], mean, std)
+                )
+                sel_labels = y_train[sel_global]
+                selected = None
+                avg_pool_loss = pool_mean(pool_losses, stat_axis)
+                ema = ema_update(ema, avg_pool_loss, config.ema_alpha)
+            elif use_pallas:
                 # Fused Pallas score→normalize→draw→p·N kernel; EMA update
                 # and the (optional) cross-worker stat psum stay outside —
                 # they are scalars.
                 from mercury_tpu.ops import score_and_draw_pallas
-                from mercury_tpu.sampling.importance import ema_update, pool_mean
 
                 avg_pool_loss = pool_mean(pool_losses, stat_axis)
                 ema = ema_update(ema, avg_pool_loss, config.ema_alpha)
@@ -168,8 +205,9 @@ def make_train_step(
             scaled_probs = jnp.ones((batch_size,), jnp.float32)
             avg_pool_loss = jnp.zeros((), jnp.float32)
 
-        sel_images = images[selected]
-        sel_labels = labels[selected]
+        if not use_groupwise:
+            sel_images = images[selected]
+            sel_labels = labels[selected]
 
         # --- train forward/backward with the unbiased IS reweighting
         # mean(loss_i/(N·p_i)) (:132-148) --------------------------------
@@ -207,6 +245,10 @@ def make_train_step(
             ema=EMAState(value=ema.value[None], count=ema.count[None]),
             stream=ShardStream(perm=stream.perm[None], cursor=stream.cursor[None]),
             rng=k_next[None],
+            groupwise=(
+                jax.tree_util.tree_map(lambda x: x[None], groupwise)
+                if use_groupwise else state.groupwise
+            ),
         )
         metrics = {
             "train/loss": loss_mean,
@@ -215,7 +257,7 @@ def make_train_step(
         }
         return new_state, metrics
 
-    specs = _state_specs(axis)
+    specs = _state_specs(axis, has_groupwise=use_groupwise)
     sharded = shard_map(
         body,
         mesh=mesh,
@@ -247,3 +289,45 @@ def make_eval_step(model) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array
         return loss_sum, correct, jnp.sum(mask)
 
     return jax.jit(eval_fn)
+
+
+def make_eval_epoch(
+    model, mean: np.ndarray, std: np.ndarray
+) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array]]:
+    """One-dispatch full-split eval: ``lax.scan`` over pre-batched uint8
+    arrays, normalize + forward + masked reduce in-graph.
+
+    The reference's ``evaluate`` walks a DataLoader batch-by-batch from the
+    host (``pytorch_collab.py:201-234``); a whole split here is a single
+    device call — this matters when dispatch latency is non-trivial (e.g. a
+    tunneled chip: ~24 host round trips become 1).
+    """
+    from mercury_tpu.data.pipeline import normalize_images
+
+    def eval_epoch(params, batch_stats, images_b, labels_b, valid_b):
+        # images_b: [nb, B, H, W, C] uint8; labels_b: [nb, B]; valid_b: [nb, B]
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+
+        def body(carry, batch):
+            imgs_u8, labels, mask = batch
+            logits = model.apply(variables, normalize_images(imgs_u8, mean, std),
+                                 train=False)
+            losses = per_sample_loss(logits, labels)
+            maskf = mask.astype(jnp.float32)
+            hit = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+            loss_sum, correct, count = carry
+            return (
+                loss_sum + jnp.sum(losses * maskf),
+                correct + jnp.sum(hit * maskf),
+                count + jnp.sum(maskf),
+            ), None
+
+        init = (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))
+        (loss_sum, correct, count), _ = jax.lax.scan(
+            body, init, (images_b, labels_b, valid_b)
+        )
+        return loss_sum, correct, count
+
+    return jax.jit(eval_epoch)

@@ -42,9 +42,8 @@ from mercury_tpu.models import create_model
 from mercury_tpu.parallel.mesh import make_mesh
 from mercury_tpu.train import checkpoint as ckpt
 from mercury_tpu.train.state import MercuryState, create_state, make_optimizer
-from mercury_tpu.train.step import make_eval_step, make_train_step
+from mercury_tpu.train.step import make_eval_epoch, make_eval_step, make_train_step
 from mercury_tpu.utils.logging import MetricsLogger
-from mercury_tpu.utils.meters import Accuracy, Average
 
 
 def build_dataset(config: TrainConfig, seed_offset: int = 0) -> ShardedDataset:
@@ -118,14 +117,20 @@ class Trainer:
             sample,
             config.world_size,
             int(self.dataset.shard_indices.shape[1]),
+            with_groupwise=(
+                config.use_importance_sampling and config.sampler == "groupwise"
+            ),
         )
         self.train_step = make_train_step(
             self.model, self.tx, config, self.mesh, self.dataset.mean, self.dataset.std
         )
         self.eval_step = make_eval_step(self.model)
+        self.eval_epoch = make_eval_epoch(self.model, self.dataset.mean,
+                                          self.dataset.std)
         self.logger = MetricsLogger(config.log_dir)
         self.history: List[Dict[str, float]] = []
         self._eval_batch = 256
+        self._eval_cache: Dict[bool, tuple] = {}
 
     # ------------------------------------------------------------------ fit
     def fit(self, num_epochs: Optional[int] = None) -> Dict[str, float]:
@@ -187,19 +192,36 @@ class Trainer:
         return final_metrics
 
     # ----------------------------------------------------------------- eval
-    def _eval_split(self, train: bool) -> Dict[str, float]:
-        acc, avg = Accuracy(), Average()
-        n = self.dataset.n_train if train else self.dataset.n_test
-        for idx, valid in eval_batches(n, self._eval_batch):
-            batch = self.dataset.gather_batch(jnp.asarray(idx), train=train)
-            loss_sum, correct, count = self.eval_step(
-                self.state.params, self.state.batch_stats, batch.image, batch.label,
+    def _eval_arrays(self, train: bool):
+        """Pre-batched uint8 arrays + masks for one split, cached — the
+        whole split then evals in a single scanned device call."""
+        if train not in self._eval_cache:
+            x = self.dataset.x_train if train else self.dataset.x_test
+            y = self.dataset.y_train if train else self.dataset.y_test
+            n = int(x.shape[0])
+            plan = eval_batches(n, self._eval_batch)
+            idx = np.stack([p[0] for p in plan])                     # [nb, B]
+            valid = np.stack([
+                np.arange(self._eval_batch) < p[1] for p in plan
+            ])                                                       # [nb, B]
+            self._eval_cache[train] = (
+                jnp.asarray(np.asarray(x)[idx]),
+                jnp.asarray(np.asarray(y)[idx]),
                 jnp.asarray(valid),
             )
-            avg.update(float(loss_sum) / max(float(count), 1), int(count))
-            acc.update_counts(int(correct), int(count))
+        return self._eval_cache[train]
+
+    def _eval_split(self, train: bool) -> Dict[str, float]:
+        images_b, labels_b, valid_b = self._eval_arrays(train)
+        loss_sum, correct, count = self.eval_epoch(
+            self.state.params, self.state.batch_stats, images_b, labels_b, valid_b
+        )
+        count = max(float(count), 1.0)
         prefix = "train" if train else "test"
-        return {f"{prefix}/eval_loss": avg.average, f"{prefix}/eval_acc": acc.accuracy}
+        return {
+            f"{prefix}/eval_loss": float(loss_sum) / count,
+            f"{prefix}/eval_acc": float(correct) / count,
+        }
 
     def evaluate(self, include_train: bool = True) -> Dict[str, float]:
         """Full train+test pass in inference mode
