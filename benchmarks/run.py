@@ -1,0 +1,134 @@
+"""Time-to-accuracy benchmark harness — fills the BASELINE.md matrix.
+
+The five BASELINE.json configs map to named presets here; each run measures
+**time-to-target test accuracy** and **images/sec/chip** (the headline
+metrics) and appends a JSON record to ``benchmarks/results.jsonl``.
+
+With real CIFAR on disk (``MERCURY_TPU_DATA``) the target defaults to the
+reference matrix's 93%; on the synthetic fallback the default target is
+99% (the synthetic task saturates quickly — the matrix is then a
+plumbing/throughput check, not an accuracy claim; the record marks which
+dataset was used).
+
+Usage::
+
+    python benchmarks/run.py --preset 3            # 4-worker collaborative IS
+    python benchmarks/run.py --preset 2 --steps 2000 --target-acc 0.90
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mercury_tpu.config import TrainConfig  # noqa: E402
+
+# BASELINE.md rows 1-5 (BASELINE.json "configs").
+PRESETS = {
+    1: dict(model="resnet18", dataset="cifar10", world_size=1,
+            use_importance_sampling=False),
+    2: dict(model="resnet18", dataset="cifar10", world_size=1),
+    3: dict(model="resnet18", dataset="cifar10", world_size=4),
+    4: dict(model="vgg11", dataset="cifar10", world_size=8),
+    5: dict(model="resnet50", dataset="cifar100", world_size=8,
+            sync_importance_stats=True),
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", type=int, default=2, choices=sorted(PRESETS))
+    ap.add_argument("--target-acc", type=float, default=None)
+    ap.add_argument("--steps", type=int, default=3000,
+                    help="max steps before giving up on the target")
+    ap.add_argument("--eval-every", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(os.path.dirname(__file__), "results.jsonl"))
+    args = ap.parse_args(argv)
+
+    overrides = dict(PRESETS[args.preset])
+    overrides.update(
+        batch_size=args.batch_size,
+        steps_per_epoch=args.steps,
+        num_epochs=1,
+        eval_every=0,   # we drive eval manually below
+        log_every=0,
+        seed=0,
+    )
+    config = TrainConfig(**overrides)
+
+    import jax
+
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    n_dev = len(jax.devices())
+    world = min(config.world_size, n_dev)
+    if world != config.world_size:
+        print(f"# only {n_dev} device(s): running world_size={world} "
+              f"(preset asks {config.world_size})", file=sys.stderr)
+        config = config.replace(world_size=world)
+    mesh = make_mesh(world, config.mesh_axis)
+    trainer = Trainer(config, mesh=mesh)
+    ds = trainer.dataset
+    synthetic = bool(os.environ.get("MERCURY_TPU_DATA") is None)
+    target = args.target_acc if args.target_acc is not None else (
+        0.93 if not synthetic else 0.99
+    )
+
+    # Warm (compile) before the clock starts.
+    trainer.state, m = trainer.train_step(
+        trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+    jax.block_until_ready(m["train/loss"])
+
+    t0 = time.perf_counter()
+    time_to_target = None
+    steps_to_target = None
+    best_acc = 0.0
+    step = 0
+    while step < args.steps:
+        for _ in range(args.eval_every):
+            trainer.state, m = trainer.train_step(
+                trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+            step += 1
+        jax.block_until_ready(m["train/loss"])
+        train_time = time.perf_counter() - t0
+        ev = trainer.evaluate(include_train=False)
+        acc = ev["test/eval_acc"]
+        best_acc = max(best_acc, acc)
+        print(f"# step {step} acc {acc:.4f} ({train_time:.1f}s)", file=sys.stderr)
+        if time_to_target is None and acc >= target:
+            time_to_target = train_time
+            steps_to_target = step
+            break
+
+    total_train_time = time.perf_counter() - t0
+    images = step * config.batch_size * config.world_size
+    record = {
+        "preset": args.preset,
+        "config": dataclasses.asdict(config),
+        "dataset_synthetic": synthetic,
+        "target_acc": target,
+        "best_acc": round(best_acc, 4),
+        "time_to_target_s": (round(time_to_target, 2)
+                             if time_to_target is not None else None),
+        "steps_to_target": steps_to_target,
+        "images_per_sec_per_chip": round(images / total_train_time / world, 1),
+        "devices": world,
+        "backend": jax.default_backend(),
+    }
+    with open(args.out, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,22 +33,53 @@ def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(directory, f"ckpt_{step}")
 
 
+def _is_key(x) -> bool:
+    try:
+        import jax.dtypes
+
+        return jax.dtypes.issubdtype(getattr(x, "dtype", None), jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+def _unwrap_keys(tree: Any) -> Any:
+    """PRNG key arrays → raw uint32 key data (serializable)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.random.key_data(x) if _is_key(x) else x, tree
+    )
+
+
+def _rewrap_keys(template: Any, tree: Any) -> Any:
+    """Inverse of :func:`_unwrap_keys`, guided by the template's key leaves."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda t, r: (
+            jax.random.wrap_key_data(jnp.asarray(np.asarray(r)))
+            if _is_key(t) else r
+        ),
+        template, tree,
+    )
+
+
 def save_checkpoint(directory: str, state: Any, step: int) -> str:
     """Save ``state`` under ``directory/ckpt_<step>``."""
     os.makedirs(directory, exist_ok=True)
     path = _ckpt_path(directory, step)
+    to_save = jax.device_get(_unwrap_keys(state))
     ocp = _orbax()
     if ocp is not None:
         try:
             ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.abspath(path), jax.device_get(state), force=True)
+            ckptr.save(os.path.abspath(path), to_save, force=True)
             return path
         except Exception:
             pass
     import flax.serialization
 
     with open(path + ".msgpack", "wb") as f:
-        f.write(flax.serialization.to_bytes(jax.device_get(state)))
+        f.write(flax.serialization.to_bytes(to_save))
     return path
 
 
@@ -73,13 +104,18 @@ def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     path = _ckpt_path(directory, step)
+    template_data = jax.device_get(_unwrap_keys(template))
     ocp = _orbax()
     if os.path.isdir(path) and ocp is not None:
         ckptr = ocp.PyTreeCheckpointer()
-        restored = ckptr.restore(os.path.abspath(path), item=jax.device_get(template))
-        return restored, step
-    import flax.serialization
+        restored = ckptr.restore(os.path.abspath(path), item=template_data)
+    else:
+        import flax.serialization
 
-    with open(path + ".msgpack", "rb") as f:
-        restored = flax.serialization.from_bytes(jax.device_get(template), f.read())
-    return restored, step
+        with open(path + ".msgpack", "rb") as f:
+            restored = flax.serialization.from_bytes(template_data, f.read())
+    # Return host-resident (uncommitted) arrays so the next jitted step is
+    # free to place them per its shardings — orbax otherwise commits
+    # everything to device 0, which conflicts with a multi-device mesh.
+    restored = jax.device_get(restored)
+    return _rewrap_keys(template, restored), step
