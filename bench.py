@@ -31,9 +31,11 @@ BATCH = 32
 POOL_BATCHES = 10
 WARMUP = 5
 STEPS = 30
+SCAN = 25          # steps fused per dispatch for the headline measurement
+SCAN_CALLS = 8     # timed dispatches → 200 steps
 
 
-def _build(use_is: bool = True):
+def _build(use_is: bool = True, scan_steps: int = 1):
     from mercury_tpu.config import TrainConfig
     from mercury_tpu.parallel.mesh import make_mesh
     from mercury_tpu.train.trainer import Trainer
@@ -49,6 +51,7 @@ def _build(use_is: bool = True):
         num_epochs=1,
         eval_every=0,
         log_every=0,
+        scan_steps=scan_steps,
         seed=0,
     )
     mesh = make_mesh(1, config.mesh_axis)
@@ -56,18 +59,29 @@ def _build(use_is: bool = True):
 
 
 def bench_fused(trainer) -> float:
+    """Throughput of the fused step; with config.scan_steps > 1 each
+    dispatch advances a whole K-step chunk (one host round-trip per chunk —
+    the TPU-native answer to being dispatch-latency-bound at batch 32)."""
     ds = trainer.dataset
     state = trainer.state
-    for _ in range(WARMUP):
-        state, metrics = trainer.train_step(state, ds.x_train, ds.y_train, ds.shard_indices)
-    jax.block_until_ready(metrics["train/loss"])
+    step_fn = trainer.train_step_many or trainer.train_step
+    k = trainer.scan_steps
+    calls = SCAN_CALLS if k > 1 else STEPS
+    # Warmup covers both compiles: the initial one, and the recompile when
+    # the donated output layout first feeds back as the input layout.
+    for _ in range(3 if k > 1 else WARMUP):
+        state, metrics = step_fn(state, ds.x_train, ds.y_train, ds.shard_indices)
+        np.asarray(metrics["train/loss"])
+    # Timing fence = host fetch of the final loss: on the tunneled-chip
+    # platform a bare block_until_ready has been observed returning early,
+    # so a device→host transfer is the only trustworthy fence.
     t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, metrics = trainer.train_step(state, ds.x_train, ds.y_train, ds.shard_indices)
-    jax.block_until_ready(metrics["train/loss"])
+    for _ in range(calls):
+        state, metrics = step_fn(state, ds.x_train, ds.y_train, ds.shard_indices)
+    np.asarray(metrics["train/loss"])
     dt = time.perf_counter() - t0
     trainer.state = state
-    return BATCH * STEPS / dt
+    return BATCH * calls * k / dt
 
 
 def bench_unfused(trainer) -> float:
@@ -136,11 +150,11 @@ def bench_unfused(trainer) -> float:
 
     for _ in range(WARMUP):
         params, batch_stats, opt_state, loss = one_step(params, batch_stats, opt_state)
-    jax.block_until_ready(loss)
+    np.asarray(loss)
     t0 = time.perf_counter()
     for _ in range(STEPS):
         params, batch_stats, opt_state, loss = one_step(params, batch_stats, opt_state)
-    jax.block_until_ready(loss)
+    np.asarray(loss)
     dt = time.perf_counter() - t0
     return BATCH * STEPS / dt
 
@@ -148,12 +162,16 @@ def bench_unfused(trainer) -> float:
 def main():
     import sys
 
-    trainer = _build(use_is=True)
+    trainer = _build(use_is=True, scan_steps=SCAN)
     fused_ips = bench_fused(trainer)
-    uniform_ips = bench_fused(_build(use_is=False))
-    unfused_ips = bench_unfused(trainer)
+    uniform_ips = bench_fused(_build(use_is=False, scan_steps=SCAN))
+    per_step_trainer = _build(use_is=True)
+    per_step_ips = bench_fused(per_step_trainer)
+    unfused_ips = bench_unfused(per_step_trainer)
     print(
-        f"# diagnostics: fused_is={fused_ips:.1f} uniform_sgd={uniform_ips:.1f} "
+        f"# diagnostics: fused_is_scan{SCAN}={fused_ips:.1f} "
+        f"uniform_sgd_scan{SCAN}={uniform_ips:.1f} "
+        f"fused_is_per_step_dispatch={per_step_ips:.1f} "
         f"unfused_reference_loop={unfused_ips:.1f} img/s "
         f"(fused vs unfused: {fused_ips / unfused_ips:.1f}x)",
         file=sys.stderr,

@@ -92,6 +92,14 @@ class TrainConfig:
     # True/False force. Pallas path requires label_smoothing == 0.
     use_pallas: Optional[bool] = None
 
+    # Dispatch --------------------------------------------------------------
+    # Train steps fused into ONE device dispatch via lax.scan. The reference
+    # pays a host round-trip per step (DataLoader pull + gloo sync,
+    # pytorch_collab.py:119-199); with a device-resident dataset the whole
+    # K-step chunk runs as a single XLA program — essential when dispatch
+    # latency rivals step compute (small models, tunneled chips).
+    scan_steps: int = 1
+
     @property
     def lr(self) -> float:
         """Linear-scaling rule: base_lr × world_size (pytorch_collab.py:28)."""

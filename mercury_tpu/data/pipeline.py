@@ -52,34 +52,57 @@ def normalize_images(images_u8: jax.Array, mean: np.ndarray, std: np.ndarray) ->
     return (x - jnp.asarray(mean)) / jnp.asarray(std)
 
 
-def _random_crop_one(key: jax.Array, img: jax.Array, pad: int) -> jax.Array:
+def _take_crops(images: jax.Array, oy: jax.Array, ox: jax.Array, out_h: int, out_w: int) -> jax.Array:
+    """Crop every image ``i`` of ``[N, H, W, C]`` at its own offset
+    ``(oy[i], ox[i])`` with two batched ``take_along_axis`` gathers — the
+    whole batch crops in two vectorized HBM reads instead of N per-image
+    dynamic slices (which lower to N serialized gathers on TPU)."""
+    idx_y = oy[:, None] + jnp.arange(out_h)[None, :]              # [N, out_h]
+    idx_x = ox[:, None] + jnp.arange(out_w)[None, :]              # [N, out_w]
+    rows = jnp.take_along_axis(images, idx_y[:, :, None, None], axis=1)
+    return jnp.take_along_axis(rows, idx_x[:, None, :, None], axis=2)
+
+
+def random_crop_batch(key: jax.Array, images: jax.Array, pad: int) -> jax.Array:
     """Zero-pad by ``pad`` then crop back to the original size at a random
-    offset (``transforms.RandomCrop(32, padding=4)``,
-    ``cifar10/data_loader.py:85``)."""
-    h, w, c = img.shape
-    padded = jnp.pad(img, ((pad, pad), (pad, pad), (0, 0)))
-    oy, ox = jax.random.randint(key, (2,), 0, 2 * pad + 1)
-    return jax.lax.dynamic_slice(padded, (oy, ox, 0), (h, w, c))
+    per-image offset (``transforms.RandomCrop(32, padding=4)``,
+    ``cifar10/data_loader.py:85``), fully batched."""
+    n, h, w, _ = images.shape
+    padded = jnp.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    off = jax.random.randint(key, (n, 2), 0, 2 * pad + 1)
+    return _take_crops(padded, off[:, 0], off[:, 1], h, w)
 
 
-def _hflip_one(key: jax.Array, img: jax.Array) -> jax.Array:
-    """Random horizontal flip, p=0.5 (``cifar10/data_loader.py:86``)."""
-    return jnp.where(jax.random.bernoulli(key), img[:, ::-1, :], img)
+def random_crop_to_batch(key: jax.Array, images: jax.Array, out: int) -> jax.Array:
+    """Random crop of ``[N, H, W, C]`` down to ``out×out`` with no padding
+    (the IID path crops a larger resized image, ``exp_dataset.py:26-27``)."""
+    n, h, w, _ = images.shape
+    oy = jax.random.randint(key, (n,), 0, h - out + 1)
+    ox = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, w - out + 1)
+    return _take_crops(images, oy, ox, out, out)
 
 
-def _cutout_one(key: jax.Array, img: jax.Array, length: int) -> jax.Array:
+def hflip_batch(key: jax.Array, images: jax.Array) -> jax.Array:
+    """Per-image random horizontal flip, p=0.5
+    (``cifar10/data_loader.py:86``)."""
+    flip = jax.random.bernoulli(key, shape=(images.shape[0],))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+def cutout_batch(key: jax.Array, images: jax.Array, length: int) -> jax.Array:
     """Square cutout mask (``Cutout``, ``cifar10/data_loader.py:57-76`` —
     defined in the reference but not wired into its transform; exposed here
-    behind a flag). Center is uniform over the image; the square is clipped
-    at the borders, exactly like the reference's ``np.clip`` logic."""
-    h, w, _ = img.shape
-    cy = jax.random.randint(key, (), 0, h)
-    cx = jax.random.randint(jax.random.fold_in(key, 1), (), 0, w)
-    ys = jnp.arange(h)[:, None]
-    xs = jnp.arange(w)[None, :]
+    behind a flag). Centers are uniform over the image; squares clip at the
+    borders, exactly like the reference's ``np.clip`` logic."""
+    n, h, w, _ = images.shape
+    cy = jax.random.randint(key, (n,), 0, h)
+    cx = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, w)
+    ys = jnp.arange(h)[None, :, None]
+    xs = jnp.arange(w)[None, None, :]
     half = length // 2
-    mask = ((ys >= cy - half) & (ys < cy + half) & (xs >= cx - half) & (xs < cx + half))
-    return jnp.where(mask[..., None], 0.0, img)
+    cy, cx = cy[:, None, None], cx[:, None, None]
+    mask = (ys >= cy - half) & (ys < cy + half) & (xs >= cx - half) & (xs < cx + half)
+    return jnp.where(mask[..., None], 0.0, images)
 
 
 def augment_batch(
@@ -90,18 +113,15 @@ def augment_batch(
     cutout_length: int = 16,
 ) -> jax.Array:
     """Jit'd train-time augmentation: random crop (pad 4) + horizontal flip
-    [+ optional cutout], vmapped per-sample — the live non-IID pipeline of
+    [+ optional cutout] — the live non-IID pipeline of
     ``_data_transforms_cifar10`` (``cifar10/data_loader.py:83-96``), run
-    on-device instead of in host worker processes."""
-    n = images.shape[0]
-    keys = jax.random.split(key, 3)
-    crop_keys = jax.random.split(keys[0], n)
-    flip_keys = jax.random.split(keys[1], n)
-    out = jax.vmap(_random_crop_one, in_axes=(0, 0, None))(crop_keys, images, pad)
-    out = jax.vmap(_hflip_one)(flip_keys, out)
+    on-device as whole-batch ops (3 RNG draws + 2 batched gathers for the
+    full pool, no per-image key splitting)."""
+    k_crop, k_flip, k_cut = jax.random.split(key, 3)
+    out = random_crop_batch(k_crop, images, pad)
+    out = hflip_batch(k_flip, out)
     if use_cutout:
-        cut_keys = jax.random.split(keys[2], n)
-        out = jax.vmap(_cutout_one, in_axes=(0, 0, None))(cut_keys, out, cutout_length)
+        out = cutout_batch(k_cut, out, cutout_length)
     return out
 
 

@@ -21,23 +21,13 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.ndimage import map_coordinates
 
-from mercury_tpu.data.pipeline import _hflip_one, _random_crop_one
+from mercury_tpu.data.pipeline import hflip_batch, random_crop_to_batch
 
 
 def resize_batch(images: jax.Array, size: int) -> jax.Array:
     """Bilinear resize to ``size×size`` (``transforms.Resize``)."""
     n, _, _, c = images.shape
     return jax.image.resize(images, (n, size, size, c), method="bilinear")
-
-
-def _crop_to(key: jax.Array, img: jax.Array, out: int) -> jax.Array:
-    """Random crop of an ``H×W`` image down to ``out×out`` (RandomCrop with
-    no padding — the IID path crops a larger resized image,
-    ``exp_dataset.py:26-27,64-68``)."""
-    h, w, c = img.shape
-    oy = jax.random.randint(key, (), 0, h - out + 1)
-    ox = jax.random.randint(jax.random.fold_in(key, 1), (), 0, w - out + 1)
-    return jax.lax.dynamic_slice(img, (oy, ox, 0), (out, out, c))
 
 
 def _affine_one(
@@ -90,10 +80,8 @@ def augment_batch_iid(
     k_crop, k_flip, k_aff = jax.random.split(key, 3)
     n = images.shape[0]
     out = resize_batch(images, resize_to)
-    out = jax.vmap(_crop_to, in_axes=(0, 0, None))(
-        jax.random.split(k_crop, n), out, crop_to
-    )
-    out = jax.vmap(_hflip_one)(jax.random.split(k_flip, n), out)
+    out = random_crop_to_batch(k_crop, out, crop_to)
+    out = hflip_batch(k_flip, out)
     out = jax.vmap(_affine_one, in_axes=(0, 0, None, None, None))(
         jax.random.split(k_aff, n), out, max_rotate_deg,
         scale_range[0], scale_range[1],
@@ -106,11 +94,8 @@ def eval_transform_iid(
 ) -> jax.Array:
     """The IID-path test transform (``exp_dataset.py:63-68``):
     resize(33) → random crop(32)."""
-    n = images.shape[0]
     out = resize_batch(images, resize_to)
-    return jax.vmap(_crop_to, in_axes=(0, 0, None))(
-        jax.random.split(key, n), out, crop_to
-    )
+    return random_crop_to_batch(key, out, crop_to)
 
 
 def truncate_channels(

@@ -67,6 +67,7 @@ def make_train_step(
     mesh: Mesh,
     mean: np.ndarray,
     std: np.ndarray,
+    scan_steps: int = 1,
 ) -> Callable[..., Tuple[MercuryState, Dict[str, jax.Array]]]:
     """Build the jitted train step.
 
@@ -74,6 +75,11 @@ def make_train_step(
     (new_state, metrics)`` where ``x_train``/``y_train`` are the full
     device-resident train arrays (replicated) and ``shard_indices`` is the
     ``[W, L]`` per-worker index matrix (sharded over the data axis).
+
+    With ``scan_steps > 1`` the returned function advances ``scan_steps``
+    steps per call — the step body wrapped in ``lax.scan`` inside the same
+    ``shard_map`` program, so one host dispatch covers the whole chunk and
+    each metric comes back as a ``[scan_steps]`` array.
     """
     axis = config.mesh_axis
     use_is = config.use_importance_sampling
@@ -257,9 +263,20 @@ def make_train_step(
         }
         return new_state, metrics
 
+    if scan_steps > 1:
+        def chunk(state, x_train, y_train, shard_indices):
+            def scan_body(s, _):
+                return body(s, x_train, y_train, shard_indices)
+
+            return lax.scan(scan_body, state, None, length=scan_steps)
+
+        fn = chunk
+    else:
+        fn = body
+
     specs = _state_specs(axis, has_groupwise=use_groupwise)
     sharded = shard_map(
-        body,
+        fn,
         mesh=mesh,
         in_specs=(specs, P(), P(), P(axis)),
         out_specs=(specs, P()),

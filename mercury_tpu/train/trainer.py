@@ -124,6 +124,27 @@ class Trainer:
         self.train_step = make_train_step(
             self.model, self.tx, config, self.mesh, self.dataset.mean, self.dataset.std
         )
+        # K-step chunked variant: one dispatch per config.scan_steps steps
+        # (lax.scan over the same body; jit is lazy, so this costs nothing
+        # unless used).
+        self.scan_steps = max(int(config.scan_steps), 1)
+        if self.scan_steps > 1:
+            for name in ("log_every", "eval_every", "checkpoint_every"):
+                every = getattr(config, name)
+                if every and every % self.scan_steps != 0:
+                    print(
+                        f"warning: {name}={every} is not a multiple of "
+                        f"scan_steps={self.scan_steps}; cadence actions fire "
+                        "at most once per chunk (at chunk boundaries)"
+                    )
+        self.train_step_many = (
+            make_train_step(
+                self.model, self.tx, config, self.mesh,
+                self.dataset.mean, self.dataset.std, scan_steps=self.scan_steps,
+            )
+            if self.scan_steps > 1
+            else None
+        )
         self.eval_step = make_eval_step(self.model)
         self.eval_epoch = make_eval_epoch(self.model, self.dataset.mean,
                                           self.dataset.std)
@@ -143,48 +164,63 @@ class Trainer:
         step = int(self.state.step)
         last_log_t, last_log_step = time.perf_counter(), step
         final_metrics: Dict[str, float] = {}
-        stop = False
-        for epoch in range(num_epochs):
-            if stop:
-                break
-            for _ in range(self.steps_per_epoch):
+
+        # End of the run: num_epochs' worth of steps from here, clipped by
+        # the step budget — the reference executes the first step for which
+        # step×world_size > budget, then breaks (:71).
+        target = step + self.steps_per_epoch * num_epochs
+        budget_cap = int(cfg.step_budget // cfg.world_size) + 1
+        end = min(target, budget_cap)
+
+        def crossed(every: int, at: int, advanced: int) -> bool:
+            """Did [at-advanced, at] cross a multiple of ``every``?"""
+            return bool(every) and (at // every) > ((at - advanced) // every)
+
+        while step < end:
+            if self.train_step_many is not None and step + self.scan_steps <= end:
+                k = self.scan_steps
+                self.state, metrics = self.train_step_many(
+                    self.state,
+                    self.dataset.x_train,
+                    self.dataset.y_train,
+                    self.dataset.shard_indices,
+                )
+                metrics = {name: v[-1] for name, v in metrics.items()}
+            else:
+                k = 1
                 self.state, metrics = self.train_step(
                     self.state,
                     self.dataset.x_train,
                     self.dataset.y_train,
                     self.dataset.shard_indices,
                 )
-                step += 1
-                if cfg.log_every and step % cfg.log_every == 0:
-                    metrics = {k: float(v) for k, v in metrics.items()}
-                    now = time.perf_counter()
-                    step_time = (now - last_log_t) / max(step - last_log_step, 1)
-                    last_log_t, last_log_step = now, step
-                    metrics["time/step"] = step_time
-                    metrics["time/images_per_sec"] = (
-                        cfg.batch_size * cfg.world_size / step_time
-                    )
-                    self.logger.log_scalars(step, metrics)
-                    print(
-                        f"epoch {epoch} step {step} "
-                        f"loss {metrics['train/loss']:.4f} "
-                        f"acc {metrics['train/acc']:.4f} "
-                        f"step_time {step_time*1000:.1f}ms"
-                    )
-                if cfg.eval_every and step % cfg.eval_every == 0:
-                    final_metrics = self.evaluate()
-                    self.logger.log_scalars(step, final_metrics)
-                    print(
-                        f"  eval @ {step}: "
-                        + " ".join(f"{k}={v:.4f}" for k, v in final_metrics.items())
-                    )
-                if cfg.checkpoint_dir and cfg.checkpoint_every and (
-                    step % cfg.checkpoint_every == 0
-                ):
-                    ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
-                if step * cfg.world_size > cfg.step_budget:  # :71
-                    stop = True
-                    break
+            step += k
+            if crossed(cfg.log_every, step, k):
+                metrics = {name: float(v) for name, v in metrics.items()}
+                now = time.perf_counter()
+                step_time = (now - last_log_t) / max(step - last_log_step, 1)
+                last_log_t, last_log_step = now, step
+                metrics["time/step"] = step_time
+                metrics["time/images_per_sec"] = (
+                    cfg.batch_size * cfg.world_size / step_time
+                )
+                self.logger.log_scalars(step, metrics)
+                epoch = (step - 1) // self.steps_per_epoch
+                print(
+                    f"epoch {epoch} step {step} "
+                    f"loss {metrics['train/loss']:.4f} "
+                    f"acc {metrics['train/acc']:.4f} "
+                    f"step_time {step_time*1000:.1f}ms"
+                )
+            if crossed(cfg.eval_every, step, k):
+                final_metrics = self.evaluate()
+                self.logger.log_scalars(step, final_metrics)
+                print(
+                    f"  eval @ {step}: "
+                    + " ".join(f"{k}={v:.4f}" for k, v in final_metrics.items())
+                )
+            if cfg.checkpoint_dir and crossed(cfg.checkpoint_every, step, k):
+                ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
         if not final_metrics:
             final_metrics = self.evaluate()
         if cfg.checkpoint_dir:
