@@ -8,18 +8,17 @@ RandomAffine(±10°, scale 0.9-1.1)`` (``:25-32``) and test transform
 ``CIFAR10_truncated.truncate_channel`` (``cifar10/datasets.py:71-75``) —
 zeroing the G/B channels of selected samples.
 
-All transforms are pure ``jax.random`` functions vmapped per sample, so
-they fuse into the train step like the non-IID pipeline in
+All transforms are pure ``jax.random`` whole-batch functions, so they
+fuse into the train step like the non-IID pipeline in
 ``mercury_tpu.data.pipeline``. The affine warp is inverse-mapped bilinear
-resampling (``jax.scipy.ndimage.map_coordinates``) — the array-native
-equivalent of torchvision's ``RandomAffine``.
+resampling as batched gathers — the array-native equivalent of
+torchvision's ``RandomAffine``.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.scipy.ndimage import map_coordinates
 
 from mercury_tpu.data.pipeline import hflip_batch, random_crop_to_batch
 
@@ -30,41 +29,61 @@ def resize_batch(images: jax.Array, size: int) -> jax.Array:
     return jax.image.resize(images, (n, size, size, c), method="bilinear")
 
 
-def _affine_one(
+def affine_batch(
     key: jax.Array,
-    img: jax.Array,
+    images: jax.Array,
     max_rotate_deg: float,
     scale_min: float,
     scale_max: float,
 ) -> jax.Array:
-    """Random rotation + isotropic scale about the image center
-    (``RandomAffine(10, scale=(0.9, 1.1))``, ``exp_dataset.py:29-31``).
-
-    Output pixel (y, x) samples the input at the inverse-transformed
-    location; out-of-bounds reads clamp to the edge (order-1 bilinear).
-    """
-    h, w, _ = img.shape
+    """Per-image random rotation + isotropic scale about the image center
+    (``RandomAffine(10, scale=(0.9, 1.1))``, ``exp_dataset.py:29-31``),
+    fully batched: 2 RNG draws for the whole batch, inverse-mapped bilinear
+    resampling as four batched gathers with edge clamping (equivalent to
+    ``map_coordinates(order=1, mode="nearest")`` per image, without N
+    per-image key splits / warps)."""
+    n, h, w, c = images.shape
     k1, k2 = jax.random.split(key)
     theta = jnp.deg2rad(
-        jax.random.uniform(k1, (), minval=-max_rotate_deg, maxval=max_rotate_deg)
+        jax.random.uniform(k1, (n,), minval=-max_rotate_deg, maxval=max_rotate_deg)
     )
-    scale = jax.random.uniform(k2, (), minval=scale_min, maxval=scale_max)
+    scale = jax.random.uniform(k2, (n,), minval=scale_min, maxval=scale_max)
     cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
     ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
                           jnp.arange(w, dtype=jnp.float32), indexing="ij")
-    yc, xc = ys - cy, xs - cx
+    yc, xc = (ys - cy)[None], (xs - cx)[None]            # [1, h, w]
     # Inverse map: rotate by -θ, scale by 1/s.
-    cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
-    inv = 1.0 / scale
-    src_y = (cos_t * yc + sin_t * xc) * inv + cy
+    cos_t = jnp.cos(theta)[:, None, None]
+    sin_t = jnp.sin(theta)[:, None, None]
+    inv = (1.0 / scale)[:, None, None]
+    src_y = (cos_t * yc + sin_t * xc) * inv + cy          # [n, h, w]
     src_x = (-sin_t * yc + cos_t * xc) * inv + cx
-    coords = jnp.stack([src_y, src_x])
 
-    def warp_channel(ch):
-        return map_coordinates(ch, coords, order=1, mode="nearest")
+    y0 = jnp.floor(src_y)
+    x0 = jnp.floor(src_x)
+    wy = (src_y - y0)[..., None]
+    wx = (src_x - x0)[..., None]
+    # Clamp each neighbor independently from the UNclamped floor: for a
+    # far-out-of-bounds coordinate both neighbors collapse to the same edge
+    # row/col (pure edge replication, no spurious blend) — matching
+    # map_coordinates(mode="nearest").
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+    y1i = jnp.clip(y0.astype(jnp.int32) + 1, 0, h - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+    x1i = jnp.clip(x0.astype(jnp.int32) + 1, 0, w - 1)
 
-    return jnp.stack([warp_channel(img[..., c]) for c in range(img.shape[-1])],
-                     axis=-1)
+    flat = images.reshape(n, h * w, c)
+
+    def sample(yi, xi):
+        idx = (yi * w + xi).reshape(n, h * w, 1)
+        return jnp.take_along_axis(flat, idx, axis=1).reshape(n, h, w, c)
+
+    return (
+        (1 - wy) * (1 - wx) * sample(y0i, x0i)
+        + (1 - wy) * wx * sample(y0i, x1i)
+        + wy * (1 - wx) * sample(y1i, x0i)
+        + wy * wx * sample(y1i, x1i)
+    )
 
 
 def augment_batch_iid(
@@ -78,15 +97,11 @@ def augment_batch_iid(
     """The IID-path train augmentation (``exp_dataset.py:25-32``):
     resize → random crop → hflip → random affine."""
     k_crop, k_flip, k_aff = jax.random.split(key, 3)
-    n = images.shape[0]
     out = resize_batch(images, resize_to)
     out = random_crop_to_batch(k_crop, out, crop_to)
     out = hflip_batch(k_flip, out)
-    out = jax.vmap(_affine_one, in_axes=(0, 0, None, None, None))(
-        jax.random.split(k_aff, n), out, max_rotate_deg,
-        scale_range[0], scale_range[1],
-    )
-    return out
+    return affine_batch(k_aff, out, max_rotate_deg,
+                        scale_range[0], scale_range[1])
 
 
 def eval_transform_iid(

@@ -34,12 +34,18 @@ from mercury_tpu.sampling.importance import per_sample_loss, reweighted_loss
 
 
 def _timeit(fn: Callable[[], jax.Array], iters: int) -> float:
-    """Median-of-iters wall time of ``fn`` with device fences."""
-    fn()  # compile / warm
+    """Median-of-iters wall time of ``fn`` with device fences.
+
+    The fence is a device→host fetch (``np.asarray``), not
+    ``block_until_ready`` — the latter has been observed returning early
+    on the tunneled-chip platform."""
+    import numpy as np
+
+    np.asarray(fn())  # compile / warm
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        np.asarray(fn())
         times.append(time.perf_counter() - t0)
     times.sort()
     return times[len(times) // 2]

@@ -55,6 +55,20 @@ class TestGroupwiseTraining:
         expect = (15 * 16) % shard_len
         np.testing.assert_array_equal(np.asarray(tr.state.groupwise.cursor), expect)
 
+    def test_groupwise_under_scan_chunks(self, mesh):
+        """The groupwise pytree (importance/generation/cursor) must carry
+        correctly through the lax.scan chunked step."""
+        tr = Trainer(gw_config(steps_per_epoch=6, scan_steps=3), mesh=mesh)
+        for _ in range(2):
+            tr.state, m = tr.train_step_many(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices,
+            )
+        assert m["train/loss"].shape == (3,)
+        np.testing.assert_array_equal(
+            np.asarray(tr.state.groupwise.generation), 6
+        )
+
     def test_importance_gets_written(self, mesh):
         tr = Trainer(gw_config(steps_per_epoch=3), mesh=mesh)
         for _ in range(3):
