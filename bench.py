@@ -35,12 +35,13 @@ SCAN = 25          # steps fused per dispatch for the headline measurement
 SCAN_CALLS = 8     # timed dispatches → 200 steps
 
 
-def _build(use_is: bool = True, scan_steps: int = 1):
+def _build(use_is: bool = True, scan_steps: int = 1, **kw):
     from mercury_tpu.config import TrainConfig
     from mercury_tpu.parallel.mesh import make_mesh
     from mercury_tpu.train.trainer import Trainer
 
     config = TrainConfig(
+        **kw,
         model="resnet18",
         dataset="synthetic",
         world_size=1,
@@ -164,12 +165,17 @@ def main():
 
     trainer = _build(use_is=True, scan_steps=SCAN)
     fused_ips = bench_fused(trainer)
+    pipelined_ips = bench_fused(
+        _build(use_is=True, scan_steps=SCAN, pipelined_scoring=True)
+    )
     uniform_ips = bench_fused(_build(use_is=False, scan_steps=SCAN))
     per_step_trainer = _build(use_is=True)
     per_step_ips = bench_fused(per_step_trainer)
     unfused_ips = bench_unfused(per_step_trainer)
+    headline_ips = max(fused_ips, pipelined_ips)  # best IS variant
     print(
         f"# diagnostics: fused_is_scan{SCAN}={fused_ips:.1f} "
+        f"pipelined_is_scan{SCAN}={pipelined_ips:.1f} "
         f"uniform_sgd_scan{SCAN}={uniform_ips:.1f} "
         f"fused_is_per_step_dispatch={per_step_ips:.1f} "
         f"unfused_reference_loop={unfused_ips:.1f} img/s "
@@ -178,9 +184,9 @@ def main():
     )
     print(json.dumps({
         "metric": "resnet18_cifar10_mercury_is_train_throughput",
-        "value": round(fused_ips, 2),
+        "value": round(headline_ips, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(fused_ips / uniform_ips, 3),
+        "vs_baseline": round(headline_ips / uniform_ips, 3),
     }))
 
 

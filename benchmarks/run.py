@@ -85,24 +85,27 @@ def main(argv=None) -> int:
         0.93 if not synthetic else 0.99
     )
 
+    import jax.numpy as jnp
     import numpy as np
 
     step_fn = trainer.train_step_many or trainer.train_step
     k = trainer.scan_steps
 
     # Warm (compile) before the clock starts — two calls so the donated-
-    # output-layout recompile is also behind us.
+    # output-layout recompile is also behind us — then RESET to the initial
+    # state so warmup neither trains nor skews the time/step accounting.
+    state0 = jax.tree_util.tree_map(jnp.copy, trainer.state)
     for _ in range(2):
         trainer.state, m = step_fn(
             trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
         np.asarray(m["train/loss"])
-    warm_steps = 2 * k
+    trainer.state = state0
 
     t0 = time.perf_counter()
     time_to_target = None
     steps_to_target = None
     best_acc = 0.0
-    step = warm_steps
+    step = 0
     while step < args.steps:
         for _ in range(max(args.eval_every // k, 1)):
             trainer.state, m = step_fn(
@@ -120,7 +123,7 @@ def main(argv=None) -> int:
             break
 
     total_train_time = time.perf_counter() - t0
-    images = (step - warm_steps) * config.batch_size * config.world_size
+    images = step * config.batch_size * config.world_size
     record = {
         "preset": args.preset,
         "config": dataclasses.asdict(config),

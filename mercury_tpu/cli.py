@@ -70,6 +70,12 @@ def parse_config(argv: Optional[Sequence[str]] = None) -> tuple[TrainConfig, arg
                 value = int(value)
         if isinstance(value, str) and value.lower() in ("none", ""):
             value = None
+        # Optional[bool] fields (e.g. use_pallas) arrive as strings; a bare
+        # string "false" would be truthy downstream.
+        if isinstance(value, str) and value.lower() in ("true", "false", "yes", "no", "1", "0"):
+            f = next(f for f in dataclasses.fields(TrainConfig) if f.name == name)
+            if "bool" in str(f.type):
+                value = value.lower() in ("true", "yes", "1")
         kw[name] = value
     return TrainConfig(**kw), args
 

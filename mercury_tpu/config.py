@@ -55,6 +55,15 @@ class TrainConfig:
     is_alpha: float = 0.5            # score = loss + alpha·EMA (pytorch_collab.py:111)
     ema_alpha: float = 0.9           # EMA smoothing factor (util.py:202)
     sync_importance_stats: bool = True  # north-star: psum (sum_loss, count) across workers
+    # Pipelined scoring (pool sampler only): step t trains on the batch
+    # selected at step t-1 and scores the NEXT pool with the same params —
+    # the train fwd/bwd and the scoring forward become independent, so XLA
+    # overlaps the scoring with the gradient collective. This is the proper
+    # realization of the reference's commented-out background-thread
+    # allreduce overlap (pytorch_collab.py:154-156) and matches its
+    # dataflow: update_samples for step t+1 runs before optimizer.step()
+    # (:158-164), i.e. selection uses pre-update params.
+    pipelined_scoring: bool = False
 
     # Augmentation ----------------------------------------------------------
     # "noniid": pad-4 random crop + hflip (the live hetero pipeline,

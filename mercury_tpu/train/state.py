@@ -10,7 +10,7 @@ deterministically (including sampler RNG — SURVEY.md §5's checkpoint gap).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import flax.struct
 import jax
@@ -20,6 +20,18 @@ import optax
 from mercury_tpu.data.pipeline import ShardStream
 from mercury_tpu.sampling.groupwise import GroupwiseState, init_groupwise
 from mercury_tpu.sampling.importance import EMAState, init_ema
+
+
+class PendingBatch(NamedTuple):
+    """The next step's pre-selected train batch (pipelined scoring).
+
+    Carries the exact augmented/normalized images that were scored — the
+    reference also trains on the very tensors ``update_samples`` scored
+    (``pytorch_collab.py:116,132``), not a re-load by index."""
+
+    images: jax.Array        # [B, H, W, C] float32 — augmented + normalized
+    labels: jax.Array        # [B] int32
+    scaled_probs: jax.Array  # [B] float32 — p_i·N for the unbiased reweight
 
 
 @flax.struct.dataclass
@@ -32,6 +44,7 @@ class MercuryState:
     stream: ShardStream             # [W]-stacked per-worker presample streams
     rng: jax.Array                  # [W, key] per-worker PRNG keys
     groupwise: Any = None           # [W]-stacked GroupwiseState (sampler="groupwise")
+    pending: Any = None             # [W]-stacked PendingBatch (pipelined_scoring)
 
 
 def create_state(
@@ -42,6 +55,8 @@ def create_state(
     n_workers: int,
     shard_len: int,
     with_groupwise: bool = False,
+    pending_batch_size: int = 0,
+    pending_image_size: Optional[int] = None,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -69,6 +84,21 @@ def create_state(
         groupwise = jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(x, (n_workers,) + x.shape), g0
         )
+    pending = None
+    if pending_batch_size:
+        # Placeholder only — step 0 primes it in-graph (the analogue of the
+        # reference's epoch-prologue update_samples call, pytorch_collab:125).
+        # The stored images are POST-augmentation, whose spatial size can
+        # differ from the raw dataset's (the IID pipeline crops to 32) —
+        # lax.cond requires the placeholder to match exactly.
+        h, w, c = sample_batch.shape[1:]
+        if pending_image_size is not None:
+            h = w = pending_image_size
+        pending = PendingBatch(
+            images=jnp.zeros((n_workers, pending_batch_size, h, w, c), jnp.float32),
+            labels=jnp.zeros((n_workers, pending_batch_size), jnp.int32),
+            scaled_probs=jnp.ones((n_workers, pending_batch_size), jnp.float32),
+        )
     return MercuryState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -78,6 +108,7 @@ def create_state(
         stream=stream,
         rng=worker_keys,
         groupwise=groupwise,
+        pending=pending,
     )
 
 

@@ -40,12 +40,14 @@ from mercury_tpu.sampling.importance import (
     reweighted_loss,
     select_from_pool,
 )
-from mercury_tpu.train.state import MercuryState
+from mercury_tpu.train.state import MercuryState, PendingBatch
 
 from jax import shard_map
 
 
-def _state_specs(axis: str, has_groupwise: bool = False) -> MercuryState:
+def _state_specs(
+    axis: str, has_groupwise: bool = False, has_pending: bool = False
+) -> MercuryState:
     """PartitionSpec pytree-prefix for :class:`MercuryState`: model/opt state
     replicated, per-worker sampler state sharded along the data axis."""
     return MercuryState(
@@ -57,6 +59,7 @@ def _state_specs(axis: str, has_groupwise: bool = False) -> MercuryState:
         stream=ShardStream(perm=P(axis), cursor=P(axis)),
         rng=P(axis),
         groupwise=P(axis) if has_groupwise else None,
+        pending=P(axis) if has_pending else None,
     )
 
 
@@ -97,6 +100,9 @@ def make_train_step(
     if config.sampler not in ("pool", "groupwise"):
         raise ValueError(f"unknown sampler {config.sampler!r}")
     use_groupwise = use_is and config.sampler == "groupwise"
+    pipelined = use_is and config.pipelined_scoring
+    if pipelined and use_groupwise:
+        raise ValueError("pipelined_scoring requires sampler='pool'")
 
     def _loss_per_sample(logits, labels):
         if use_pallas:
@@ -132,88 +138,138 @@ def make_train_step(
             raise ValueError(f"unknown augmentation {config.augmentation!r}")
         return images
 
+    def _select(k_sel, pool_losses, ema):
+        """EMA update + score→normalize→draw, returning
+        ``(selected, scaled_probs, new_ema, avg_pool_loss)`` — shared by the
+        inline and pipelined paths (Pallas or jax-native)."""
+        if use_pallas:
+            from mercury_tpu.ops import score_and_draw_pallas
+
+            avg = pool_mean(pool_losses, stat_axis)
+            new_ema = ema_update(ema, avg, config.ema_alpha)
+            _, selected, scaled = score_and_draw_pallas(
+                k_sel, pool_losses, new_ema.value, batch_size, config.is_alpha
+            )
+            return selected, scaled, new_ema, avg
+        sel = select_from_pool(
+            k_sel, pool_losses, ema, batch_size,
+            is_alpha=config.is_alpha, ema_alpha=config.ema_alpha,
+            axis_name=stat_axis,
+        )
+        return sel.selected, sel.scaled_probs, sel.ema, sel.avg_pool_loss
+
     def body(state: MercuryState, x_train, y_train, shard_indices):
         # Leading axis inside shard_map is this device's single worker row.
         rng = state.rng[0]
-        k_stream, k_aug, k_sel, k_aug2, k_next = jax.random.split(rng, 5)
+        (k_stream, k_aug, k_sel, k_aug2, k_boot_stream, k_boot_aug,
+         k_boot_sel, k_next) = jax.random.split(rng, 8)
 
         groupwise = None
+        new_pending = None
         stream = ShardStream(perm=state.stream.perm[0], cursor=state.stream.cursor[0])
-        if use_groupwise:
-            # Sliding-window refresh over the shard (util.py:114-138): the
-            # next `pool_size` slots in order, wrapping — no shuffle.
-            from mercury_tpu.sampling.groupwise import (
-                draw as gw_draw,
-                update_importance,
-                window_indices,
-            )
-
-            groupwise = jax.tree_util.tree_map(lambda x: x[0], state.groupwise)
-            slots = window_indices(groupwise, pool_size)
-        else:
-            # Shuffled wrapping presample stream (≡ Trainer.get_next over
-            # the presampling loader, :74-82).
-            stream, slots = next_pool(stream, k_stream, pool_size)
-        global_idx = shard_indices[0][slots]
-        images = _augment(k_aug, normalize_images(x_train[global_idx], mean, std))
-        labels = y_train[global_idx]
-
         ema = EMAState(value=state.ema.value[0], count=state.ema.count[0])
 
-        if use_is:
-            # --- importance scoring: ONE batched inference forward over the
-            # pool (≡ the 10-iteration no_grad loop, :95-106), batch-stat
-            # normalization, running-stat updates discarded ----------------
-            pool_logits, _ = _apply_train(state.params, state.batch_stats, images, False)
-            pool_losses = _loss_per_sample(pool_logits, labels)
-            if use_groupwise:
-                # Persist scores into the shard-wide importance array, tag
-                # the new generation, draw from it with the +mean shift
-                # (util.py:133-153). Drawn slots are re-gathered and
-                # re-augmented (the sampler re-loads by index, as the
-                # reference's does via get_slice, util.py:123).
-                groupwise = update_importance(groupwise, slots, pool_losses)
-                sel_slots, scaled_probs = gw_draw(groupwise, k_sel, batch_size)
-                sel_global = shard_indices[0][sel_slots]
-                sel_images = _augment(
-                    k_aug2, normalize_images(x_train[sel_global], mean, std)
+        if pipelined:
+            # --- pipelined scoring: train on the batch selected last step,
+            # score the NEXT pool with the same (pre-update) params — the
+            # two chains are independent, so XLA overlaps the scoring
+            # forward with the gradient collective. Reference dataflow:
+            # update_samples for t+1 runs before optimizer.step
+            # (pytorch_collab.py:158-164). --------------------------------
+            def score_next(stream, ema, ks, ka, ksel):
+                stream, slots = next_pool(stream, ks, pool_size)
+                gidx = shard_indices[0][slots]
+                imgs = _augment(ka, normalize_images(x_train[gidx], mean, std))
+                labs = y_train[gidx]
+                pool_logits, _ = _apply_train(
+                    state.params, state.batch_stats, imgs, False
                 )
-                sel_labels = y_train[sel_global]
-                selected = None
-                avg_pool_loss = pool_mean(pool_losses, stat_axis)
-                ema = ema_update(ema, avg_pool_loss, config.ema_alpha)
-            elif use_pallas:
-                # Fused Pallas score→normalize→draw→p·N kernel; EMA update
-                # and the (optional) cross-worker stat psum stay outside —
-                # they are scalars.
-                from mercury_tpu.ops import score_and_draw_pallas
+                pool_losses = _loss_per_sample(pool_logits, labs)
+                selected, scaled, ema, avg = _select(ksel, pool_losses, ema)
+                pend = PendingBatch(
+                    images=imgs[selected], labels=labs[selected],
+                    scaled_probs=scaled,
+                )
+                return stream, ema, pend, avg
 
-                avg_pool_loss = pool_mean(pool_losses, stat_axis)
-                ema = ema_update(ema, avg_pool_loss, config.ema_alpha)
-                _, selected, scaled_probs = score_and_draw_pallas(
-                    k_sel, pool_losses, ema.value, batch_size, config.is_alpha
-                )
-            else:
-                sel = select_from_pool(
-                    k_sel, pool_losses, ema, batch_size,
-                    is_alpha=config.is_alpha, ema_alpha=config.ema_alpha,
-                    axis_name=stat_axis,
-                )
-                selected, scaled_probs = sel.selected, sel.scaled_probs
-                ema = sel.ema
-                avg_pool_loss = sel.avg_pool_loss
+            stored = jax.tree_util.tree_map(lambda x: x[0], state.pending)
+
+            # Step 0 primes the pending batch in-graph (≡ the epoch-prologue
+            # update_samples call, pytorch_collab.py:125).
+            def boot(args):
+                s, e = args
+                return score_next(s, e, k_boot_stream, k_boot_aug, k_boot_sel)
+
+            def keep(args):
+                s, e = args
+                return s, e, stored, jnp.zeros((), jnp.float32)
+
+            stream, ema, current, _ = lax.cond(
+                state.step == 0, boot, keep, (stream, ema)
+            )
+            sel_images, sel_labels = current.images, current.labels
+            scaled_probs = current.scaled_probs
+            stream, ema, new_pending, avg_pool_loss = score_next(
+                stream, ema, k_stream, k_aug, k_sel
+            )
         else:
-            # Uniform baseline: consume the freshly streamed batch directly —
-            # the stream is a shuffled without-replacement epoch pass, i.e.
-            # standard shuffled-loader SGD — with unit IS weights so
-            # loss/(N·p) = loss.
-            selected = jnp.arange(batch_size, dtype=jnp.int32)
-            scaled_probs = jnp.ones((batch_size,), jnp.float32)
-            avg_pool_loss = jnp.zeros((), jnp.float32)
+            if use_groupwise:
+                # Sliding-window refresh over the shard (util.py:114-138):
+                # the next `pool_size` slots in order, wrapping — no shuffle.
+                from mercury_tpu.sampling.groupwise import (
+                    draw as gw_draw,
+                    update_importance,
+                    window_indices,
+                )
 
-        if not use_groupwise:
-            sel_images = images[selected]
-            sel_labels = labels[selected]
+                groupwise = jax.tree_util.tree_map(lambda x: x[0], state.groupwise)
+                slots = window_indices(groupwise, pool_size)
+            else:
+                # Shuffled wrapping presample stream (≡ Trainer.get_next over
+                # the presampling loader, :74-82).
+                stream, slots = next_pool(stream, k_stream, pool_size)
+            global_idx = shard_indices[0][slots]
+            images = _augment(k_aug, normalize_images(x_train[global_idx], mean, std))
+            labels = y_train[global_idx]
+
+            if use_is:
+                # --- importance scoring: ONE batched inference forward over
+                # the pool (≡ the 10-iteration no_grad loop, :95-106),
+                # batch-stat normalization, running-stat updates discarded --
+                pool_logits, _ = _apply_train(
+                    state.params, state.batch_stats, images, False
+                )
+                pool_losses = _loss_per_sample(pool_logits, labels)
+                if use_groupwise:
+                    # Persist scores into the shard-wide importance array,
+                    # tag the new generation, draw from it with the +mean
+                    # shift (util.py:133-153). Drawn slots are re-gathered
+                    # and re-augmented (the sampler re-loads by index, as
+                    # the reference's does via get_slice, util.py:123).
+                    groupwise = update_importance(groupwise, slots, pool_losses)
+                    sel_slots, scaled_probs = gw_draw(groupwise, k_sel, batch_size)
+                    sel_global = shard_indices[0][sel_slots]
+                    sel_images = _augment(
+                        k_aug2, normalize_images(x_train[sel_global], mean, std)
+                    )
+                    sel_labels = y_train[sel_global]
+                    avg_pool_loss = pool_mean(pool_losses, stat_axis)
+                    ema = ema_update(ema, avg_pool_loss, config.ema_alpha)
+                else:
+                    selected, scaled_probs, ema, avg_pool_loss = _select(
+                        k_sel, pool_losses, ema
+                    )
+                    sel_images = images[selected]
+                    sel_labels = labels[selected]
+            else:
+                # Uniform baseline: consume the freshly streamed batch
+                # directly — the stream is a shuffled without-replacement
+                # epoch pass, i.e. standard shuffled-loader SGD — with unit
+                # IS weights so loss/(N·p) = loss.
+                sel_images = images[:batch_size]
+                sel_labels = labels[:batch_size]
+                scaled_probs = jnp.ones((batch_size,), jnp.float32)
+                avg_pool_loss = jnp.zeros((), jnp.float32)
 
         # --- train forward/backward with the unbiased IS reweighting
         # mean(loss_i/(N·p_i)) (:132-148) --------------------------------
@@ -255,6 +311,10 @@ def make_train_step(
                 jax.tree_util.tree_map(lambda x: x[None], groupwise)
                 if use_groupwise else state.groupwise
             ),
+            pending=(
+                jax.tree_util.tree_map(lambda x: x[None], new_pending)
+                if pipelined else state.pending
+            ),
         )
         metrics = {
             "train/loss": loss_mean,
@@ -274,7 +334,7 @@ def make_train_step(
     else:
         fn = body
 
-    specs = _state_specs(axis, has_groupwise=use_groupwise)
+    specs = _state_specs(axis, has_groupwise=use_groupwise, has_pending=pipelined)
     sharded = shard_map(
         fn,
         mesh=mesh,

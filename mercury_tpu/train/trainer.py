@@ -120,6 +120,15 @@ class Trainer:
             with_groupwise=(
                 config.use_importance_sampling and config.sampler == "groupwise"
             ),
+            pending_batch_size=(
+                config.batch_size
+                if config.use_importance_sampling and config.pipelined_scoring
+                else 0
+            ),
+            # The IID augmentation pipeline crops to 32 regardless of the raw
+            # image size (exp_dataset.py:26-27); noniid/none keep it.
+            pending_image_size=(32 if config.augmentation == "iid"
+                                else config.image_size),
         )
         self.train_step = make_train_step(
             self.model, self.tx, config, self.mesh, self.dataset.mean, self.dataset.std

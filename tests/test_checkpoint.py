@@ -69,6 +69,22 @@ class TestCheckpointRoundtrip:
         losses_c = run_steps(tr_c, 2)
         np.testing.assert_allclose(losses_c, losses_a[2:], rtol=1e-5)
 
+    def test_pipelined_resume_is_deterministic(self, mesh, tmp_path):
+        """The carried PendingBatch (pipelined scoring) is part of the
+        checkpoint: resume mid-pipeline reproduces the straight run."""
+        cfg = tiny(pipelined_scoring=True)
+        tr_a = Trainer(cfg, mesh=mesh)
+        losses_a = run_steps(tr_a, 4)
+
+        tr_b = Trainer(cfg, mesh=mesh)
+        run_steps(tr_b, 2)
+        save_checkpoint(str(tmp_path), tr_b.state, 2)
+
+        tr_c = Trainer(cfg, mesh=mesh)
+        tr_c.state, _ = restore_checkpoint(str(tmp_path), tr_c.state)
+        losses_c = run_steps(tr_c, 2)
+        np.testing.assert_allclose(losses_c, losses_a[2:], rtol=1e-5)
+
     def test_multiple_checkpoints_latest_wins(self, mesh, tmp_path):
         tr = Trainer(tiny(), mesh=mesh)
         save_checkpoint(str(tmp_path), tr.state, 1)

@@ -126,6 +126,58 @@ class TestConvergence:
         assert np.mean(losses[-5:]) < np.mean(losses[:5])
 
 
+class TestPipelinedScoring:
+    def test_trains_and_converges(self, mesh):
+        """Pipelined mode: step t trains on the t-1 selection while scoring
+        the next pool; step 0 self-primes in-graph. Loss must still fall."""
+        cfg = tiny_config(pipelined_scoring=True, steps_per_epoch=30,
+                          batch_size=16, presample_batches=2)
+        tr = Trainer(cfg, mesh=mesh)
+        assert tr.state.pending is not None
+        assert tr.state.pending.images.shape == (8, 16, 32, 32, 3)
+        losses = []
+        for _ in range(30):
+            tr.state, m = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices,
+            )
+            losses.append(float(m["train/loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
+        # Pending holds a real (selected) batch, not the zero placeholder.
+        assert float(np.abs(np.asarray(tr.state.pending.images)).max()) > 0
+
+    def test_pipelined_under_scan(self, mesh):
+        cfg = tiny_config(pipelined_scoring=True, scan_steps=4)
+        tr = Trainer(cfg, mesh=mesh)
+        tr.state, m = tr.train_step_many(
+            tr.state, tr.dataset.x_train, tr.dataset.y_train,
+            tr.dataset.shard_indices,
+        )
+        assert m["train/loss"].shape == (4,)
+        assert np.isfinite(np.asarray(m["train/loss"])).all()
+        assert int(tr.state.step) == 4
+
+    def test_pipelined_with_iid_augmentation(self, mesh):
+        """The carried PendingBatch stores POST-augmentation images; the IID
+        pipeline crops to 32 — the placeholder must match or lax.cond's
+        branches disagree at trace time."""
+        cfg = tiny_config(pipelined_scoring=True, augmentation="iid",
+                          steps_per_epoch=2)
+        tr = Trainer(cfg, mesh=mesh)
+        for _ in range(2):
+            tr.state, m = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices,
+            )
+        assert np.isfinite(float(m["train/loss"]))
+
+    def test_groupwise_rejects_pipelined(self, mesh):
+        cfg = tiny_config(pipelined_scoring=True, sampler="groupwise")
+        with pytest.raises(ValueError, match="pipelined"):
+            Trainer(cfg, mesh=mesh)
+
+
 class TestScannedSteps:
     def test_scan_matches_single_steps(self, mesh):
         """K steps via the lax.scan chunk ≡ K single-step dispatches: same
