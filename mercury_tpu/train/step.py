@@ -369,7 +369,7 @@ def make_eval_step(model) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array
 
 
 def make_eval_epoch(
-    model, mean: np.ndarray, std: np.ndarray
+    model, mean: np.ndarray, std: np.ndarray, eval_augmentation: str = "none"
 ) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array]]:
     """One-dispatch full-split eval: ``lax.scan`` over pre-batched uint8
     arrays, normalize + forward + masked reduce in-graph.
@@ -378,6 +378,12 @@ def make_eval_epoch(
     host (``pytorch_collab.py:201-234``); a whole split here is a single
     device call — this matters when dispatch latency is non-trivial (e.g. a
     tunneled chip: ~24 host round trips become 1).
+
+    ``eval_augmentation="iid"`` applies the reference IID path's *test*
+    transform — resize(33) → random crop(32) (``exp_dataset.py:63-68``; yes,
+    the reference random-crops at eval) — with a fixed key per batch so
+    eval stays deterministic. The live non-IID path normalizes only
+    (``cifar10/data_loader.py:92-96``).
     """
     from mercury_tpu.data.pipeline import normalize_images
 
@@ -389,8 +395,15 @@ def make_eval_epoch(
 
         def body(carry, batch):
             imgs_u8, labels, mask = batch
-            logits = model.apply(variables, normalize_images(imgs_u8, mean, std),
-                                 train=False)
+            imgs = normalize_images(imgs_u8, mean, std)
+            if eval_augmentation == "iid":
+                from mercury_tpu.data.transforms import eval_transform_iid
+
+                # Deterministic: key derived from the batch's first label
+                # sum is overkill — a fixed key is what "same transform
+                # every eval" means here.
+                imgs = eval_transform_iid(jax.random.key(0), imgs)
+            logits = model.apply(variables, imgs, train=False)
             losses = per_sample_loss(logits, labels)
             maskf = mask.astype(jnp.float32)
             hit = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)

@@ -156,7 +156,10 @@ class Trainer:
         )
         self.eval_step = make_eval_step(self.model)
         self.eval_epoch = make_eval_epoch(self.model, self.dataset.mean,
-                                          self.dataset.std)
+                                          self.dataset.std,
+                                          eval_augmentation=config.augmentation
+                                          if config.augmentation == "iid"
+                                          else "none")
         self.logger = MetricsLogger(config.log_dir)
         self.history: List[Dict[str, float]] = []
         self._eval_batch = 256

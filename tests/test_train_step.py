@@ -236,6 +236,15 @@ class TestScannedSteps:
 
 
 class TestEval:
+    def test_iid_eval_transform_applied(self, mesh):
+        """IID config evaluates through the reference's test transform
+        (resize 33 → crop 32, exp_dataset.py:63-68) and still returns
+        finite metrics."""
+        cfg = tiny_config(augmentation="iid", steps_per_epoch=1)
+        tr = Trainer(cfg, mesh=mesh)
+        out = tr.evaluate(include_train=False)
+        assert np.isfinite(out["test/eval_loss"])
+
     def test_evaluate_returns_metrics(self, trainer):
         out = trainer.evaluate()
         for k in ("train/eval_loss", "train/eval_acc", "test/eval_loss", "test/eval_acc"):
