@@ -1,0 +1,36 @@
+"""The two non-default sampler modes.
+
+1. Pipelined scoring — overlap the importance-scoring forward with the
+   gradient collective (the reference's commented-out thread overlap,
+   ``pytorch_collab.py:154-156``, done properly in-graph).
+2. Groupwise sampler — the reference's library-only ``Groupwise_Sampler``
+   (``util.py:94-160``) as a first-class strategy: persistent per-sample
+   importance with sliding-window refresh.
+
+Run:  python examples/pipelined_groupwise.py
+"""
+
+from mercury_tpu import TrainConfig
+from mercury_tpu.train import Trainer
+
+BASE = dict(
+    model="resnet18",
+    dataset="cifar10",
+    world_size=1,
+    num_epochs=1,
+    steps_per_epoch=200,
+    log_every=50,
+    eval_every=200,
+)
+
+
+def main():
+    print("== pipelined pool sampler ==")
+    Trainer(TrainConfig(**BASE, pipelined_scoring=True, scan_steps=25)).fit()
+
+    print("== groupwise sliding-window sampler ==")
+    Trainer(TrainConfig(**BASE, sampler="groupwise")).fit()
+
+
+if __name__ == "__main__":
+    main()
