@@ -125,6 +125,40 @@ def synthetic_cifar(
     return make(train_size, 1), make(test_size, 2)
 
 
+def synthetic_sequences(
+    num_classes: int = 10,
+    train_size: int = 5000,
+    test_size: int = 1000,
+    seq_len: int = 32,
+    feature_dim: int = 16,
+    seed: int = 0,
+) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic learnable sequence dataset ``[N, T, F]`` float32 — a
+    stand-in for the speech/audio workloads the reference's ``MyLSTM``
+    targets (``pytorch_model.py:208-241``; never wired to training there).
+
+    Each class is a fixed random frequency/phase pattern per feature
+    channel; samples add per-sample noise at varying scale so importance
+    sampling has signal.
+    """
+    rng = np.random.default_rng(seed)
+    freqs = rng.uniform(0.5, 4.0, (num_classes, feature_dim)).astype(np.float32)
+    phases = rng.uniform(0, 2 * np.pi, (num_classes, feature_dim)).astype(np.float32)
+    t = np.arange(seq_len, dtype=np.float32)[None, :, None]  # [1, T, 1]
+
+    def make(n, offset):
+        local = np.random.default_rng(seed + offset)
+        y = local.integers(0, num_classes, n).astype(np.int32)
+        base = np.sin(
+            2 * np.pi * freqs[y][:, None, :] * t / seq_len + phases[y][:, None, :]
+        )  # [n, T, F]
+        noise_scale = local.uniform(0.2, 1.0, (n, 1, 1)).astype(np.float32)
+        noise = local.normal(0, 1, (n, seq_len, feature_dim)).astype(np.float32)
+        return (base + noise_scale * noise).astype(np.float32), y
+
+    return make(train_size, 1), make(test_size, 2)
+
+
 def find_data_dir(explicit: Optional[str] = None) -> Optional[str]:
     """Resolve the dataset root: explicit arg → $MERCURY_TPU_DATA → defaults."""
     candidates = []
@@ -163,6 +197,19 @@ def load_dataset(
             "num_classes": num_classes,
             "mean": CIFAR10_MEAN,
             "std": CIFAR10_STD,
+            "synthetic": True,
+        }
+
+    if name == "synthetic_seq":
+        num_classes = 10
+        train, test = synthetic_sequences(
+            num_classes, synthetic_train_size, synthetic_test_size, seed=seed
+        )
+        # Sequences are already float; normalization is identity.
+        return train, test, {
+            "num_classes": num_classes,
+            "mean": np.zeros((1,), np.float32),
+            "std": np.ones((1,), np.float32),
             "synthetic": True,
         }
 

@@ -45,10 +45,15 @@ class ShardStream(NamedTuple):
     cursor: jax.Array  # [] int32 — next unread slot
 
 
-def normalize_images(images_u8: jax.Array, mean: np.ndarray, std: np.ndarray) -> jax.Array:
+def normalize_images(images: jax.Array, mean: np.ndarray, std: np.ndarray) -> jax.Array:
     """uint8 NHWC → normalized float (``cifar10/data_loader.py:83-96``:
-    ``ToTensor`` + ``Normalize(mean, std)``)."""
-    x = images_u8.astype(jnp.float32) / 255.0
+    ``ToTensor`` + ``Normalize(mean, std)``). Float inputs (e.g. feature
+    sequences ``[N, T, F]``) skip the /255 scaling; mean/std broadcast over
+    the trailing axis."""
+    if images.dtype == jnp.uint8:
+        x = images.astype(jnp.float32) / 255.0
+    else:
+        x = images.astype(jnp.float32)
     return (x - jnp.asarray(mean)) / jnp.asarray(std)
 
 

@@ -56,7 +56,7 @@ def create_state(
     shard_len: int,
     with_groupwise: bool = False,
     pending_batch_size: int = 0,
-    pending_image_size: Optional[int] = None,
+    pending_sample_shape: Optional[tuple] = None,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -88,14 +88,13 @@ def create_state(
     if pending_batch_size:
         # Placeholder only — step 0 primes it in-graph (the analogue of the
         # reference's epoch-prologue update_samples call, pytorch_collab:125).
-        # The stored images are POST-augmentation, whose spatial size can
-        # differ from the raw dataset's (the IID pipeline crops to 32) —
-        # lax.cond requires the placeholder to match exactly.
-        h, w, c = sample_batch.shape[1:]
-        if pending_image_size is not None:
-            h = w = pending_image_size
+        # The stored samples are POST-augmentation, whose shape can differ
+        # from the raw dataset's (the IID pipeline crops to 32) — lax.cond
+        # requires the placeholder to match exactly.
+        shape = (tuple(pending_sample_shape) if pending_sample_shape is not None
+                 else tuple(sample_batch.shape[1:]))
         pending = PendingBatch(
-            images=jnp.zeros((n_workers, pending_batch_size, h, w, c), jnp.float32),
+            images=jnp.zeros((n_workers, pending_batch_size) + shape, jnp.float32),
             labels=jnp.zeros((n_workers, pending_batch_size), jnp.int32),
             scaled_probs=jnp.ones((n_workers, pending_batch_size), jnp.float32),
         )

@@ -107,9 +107,18 @@ class Trainer:
             config.optimizer, config.lr, total_steps, config.weight_decay
         )
 
-        sample = jnp.zeros(
-            (1, config.image_size, config.image_size, 3), jnp.float32
-        )
+        # Model-init sample and pending-batch shapes come from the dataset
+        # itself: [H, W, C] for images, [T, F] for sequences (the BiLSTM
+        # speech path — beyond the reference, which never trains MyLSTM).
+        sample_shape = tuple(int(s) for s in self.dataset.x_train.shape[1:])
+        is_image = len(sample_shape) == 3
+        if not is_image and config.augmentation != "none":
+            raise ValueError(
+                f"augmentation={config.augmentation!r} needs image data; "
+                f"dataset {config.dataset!r} has sample shape {sample_shape} — "
+                "set augmentation='none'"
+            )
+        sample = jnp.zeros((1,) + sample_shape, jnp.float32)
         self.state: MercuryState = create_state(
             jax.random.key(config.seed),
             self.model,
@@ -126,9 +135,11 @@ class Trainer:
                 else 0
             ),
             # The IID augmentation pipeline crops to 32 regardless of the raw
-            # image size (exp_dataset.py:26-27); noniid/none keep it.
-            pending_image_size=(32 if config.augmentation == "iid"
-                                else config.image_size),
+            # image size (exp_dataset.py:26-27); noniid/none keep the
+            # dataset's own sample shape.
+            pending_sample_shape=((32, 32, sample_shape[-1])
+                                  if config.augmentation == "iid"
+                                  else sample_shape),
         )
         self.train_step = make_train_step(
             self.model, self.tx, config, self.mesh, self.dataset.mean, self.dataset.std

@@ -126,6 +126,35 @@ class TestConvergence:
         assert np.mean(losses[-5:]) < np.mean(losses[:5])
 
 
+class TestSequenceTraining:
+    """The BiLSTM+attention family trains end-to-end through the same
+    Mercury step (the reference defines MyLSTM but never wires it to
+    training — pytorch_model.py:208-241, SURVEY.md §2.3)."""
+
+    def test_bilstm_trains_on_sequences(self, mesh):
+        cfg = tiny_config(model="bilstm_attention", dataset="synthetic_seq",
+                          augmentation="none", batch_size=16,
+                          presample_batches=2, steps_per_epoch=25)
+        tr = Trainer(cfg, mesh=mesh)
+        assert tr.dataset.x_train.ndim == 3  # [N, T, F]
+        losses = []
+        for _ in range(25):
+            tr.state, m = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices,
+            )
+            losses.append(float(m["train/loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
+        out = tr.evaluate(include_train=False)
+        assert np.isfinite(out["test/eval_loss"])
+
+    def test_sequence_rejects_image_augmentation(self, mesh):
+        cfg = tiny_config(model="bilstm_attention", dataset="synthetic_seq")
+        with pytest.raises(ValueError, match="augmentation"):
+            Trainer(cfg, mesh=mesh)
+
+
 class TestPipelinedScoring:
     def test_trains_and_converges(self, mesh):
         """Pipelined mode: step t trains on the t-1 selection while scoring
