@@ -134,11 +134,11 @@ class TestSequenceTraining:
     def test_bilstm_trains_on_sequences(self, mesh):
         cfg = tiny_config(model="bilstm_attention", dataset="synthetic_seq",
                           augmentation="none", batch_size=16,
-                          presample_batches=2, steps_per_epoch=25)
+                          presample_batches=2, steps_per_epoch=15)
         tr = Trainer(cfg, mesh=mesh)
         assert tr.dataset.x_train.ndim == 3  # [N, T, F]
         losses = []
-        for _ in range(25):
+        for _ in range(15):
             tr.state, m = tr.train_step(
                 tr.state, tr.dataset.x_train, tr.dataset.y_train,
                 tr.dataset.shard_indices,
