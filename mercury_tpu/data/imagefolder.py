@@ -72,3 +72,44 @@ def load_image_folder(
     if not images:
         raise FileNotFoundError(f"no images with {IMG_EXTENSIONS} under {root!r}")
     return np.stack(images), np.asarray(labels, np.int32), classes
+
+
+def load_imagefolder_dataset(
+    root: str, image_size: Optional[int] = 32, test_fraction: float = 0.1,
+    seed: int = 0,
+) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray], dict]:
+    """Full-dataset ingest for training: ``(train, test, info)``.
+
+    Layout: ``root/train/<class>/...`` and ``root/test/<class>/...``
+    (torchvision convention). With no ``train``/``test`` subdirs,
+    ``root/<class>/...`` is split ``1−test_fraction``/``test_fraction``
+    with a seeded shuffle. Normalization stats are computed from the train
+    split. This is what turns :func:`load_image_folder` (the
+    ``SampleImageFolder`` parity shim) into a first-class Trainer dataset:
+    ``TrainConfig(dataset="imagefolder", data_dir=root)``.
+    """
+    train_dir = os.path.join(root, "train")
+    test_dir = os.path.join(root, "test")
+    if os.path.isdir(train_dir) and os.path.isdir(test_dir):
+        x_tr, y_tr, classes = load_image_folder(train_dir, image_size)
+        x_te, y_te, test_classes = load_image_folder(test_dir, image_size)
+        if test_classes != classes:
+            raise ValueError(
+                f"train/test class mismatch: {classes} vs {test_classes}"
+            )
+    else:
+        x, y, classes = load_image_folder(root, image_size)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(len(x))
+        n_test = max(int(len(x) * test_fraction), 1)
+        te, tr = perm[:n_test], perm[n_test:]
+        x_tr, y_tr, x_te, y_te = x[tr], y[tr], x[te], y[te]
+    mean = (x_tr.astype(np.float32) / 255.0).mean(axis=(0, 1, 2))
+    std = (x_tr.astype(np.float32) / 255.0).std(axis=(0, 1, 2)) + 1e-6
+    return (x_tr, y_tr), (x_te, y_te), {
+        "num_classes": len(classes),
+        "classes": classes,
+        "mean": mean,
+        "std": std,
+        "synthetic": False,
+    }

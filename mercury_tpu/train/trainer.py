@@ -49,9 +49,19 @@ from mercury_tpu.utils.logging import MetricsLogger
 def build_dataset(config: TrainConfig, seed_offset: int = 0) -> ShardedDataset:
     """Load + partition per config (≡ ``__main__``'s parent-process dataset
     build, ``pytorch_collab.py:280-282`` → ``exp_dataset.py``)."""
-    train, test, info = cifar.load_dataset(
-        config.dataset, data_dir=config.data_dir, seed=config.seed + seed_offset
-    )
+    if config.dataset == "imagefolder":
+        from mercury_tpu.data.imagefolder import load_imagefolder_dataset
+
+        if not config.data_dir:
+            raise ValueError("dataset='imagefolder' requires data_dir")
+        train, test, info = load_imagefolder_dataset(
+            config.data_dir, image_size=config.image_size,
+            seed=config.seed + seed_offset,
+        )
+    else:
+        train, test, info = cifar.load_dataset(
+            config.dataset, data_dir=config.data_dir, seed=config.seed + seed_offset
+        )
     mode = "hetero" if config.noniid else "homo"
     shards = partition_data(
         train[1],

@@ -160,6 +160,40 @@ class TestImageFolder:
         with pytest.raises(FileNotFoundError):
             load_image_folder(str(tmp_path))
 
+    def test_imagefolder_trains_end_to_end(self, tmp_path):
+        """dataset='imagefolder' is a first-class Trainer dataset (the
+        SampleImageFolder capability, util.py:162-181, wired to training)."""
+        from PIL import Image
+
+        rng = np.random.default_rng(0)
+        for cls_i, cls in enumerate(("a", "b")):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(20):
+                arr = rng.integers(0, 60, (32, 32, 3)).astype(np.uint8)
+                arr[..., cls_i] += 150  # separable classes
+                Image.fromarray(arr).save(d / f"x{i}.png")
+
+        from mercury_tpu.config import TrainConfig
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+        from mercury_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(model="smallcnn", dataset="imagefolder",
+                          data_dir=str(tmp_path), world_size=2, batch_size=4,
+                          presample_batches=2, steps_per_epoch=3, num_epochs=1,
+                          noniid=False, eval_every=0, log_every=0,
+                          compute_dtype="float32", min_shard_size=2, seed=0)
+        tr = Trainer(cfg, mesh=host_cpu_mesh(2))
+        assert tr.dataset.num_classes == 2
+        for _ in range(3):
+            tr.state, m = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices,
+            )
+        assert np.isfinite(float(m["train/loss"]))
+        out = tr.evaluate(include_train=False)
+        assert np.isfinite(out["test/eval_loss"])
+
     def test_pil_to_numpy(self):
         from PIL import Image
 
