@@ -98,6 +98,17 @@ class TestCheckpointRoundtrip:
 
 
 class TestProfile:
+    def test_trace_context_writes_profile(self, tmp_path):
+        """jax.profiler trace wrapper produces trace artifacts."""
+        import jax.numpy as jnp
+
+        from mercury_tpu.train.profile import trace
+
+        with trace(str(tmp_path)):
+            jnp.ones((8, 8)).sum().block_until_ready()
+        dumped = list(tmp_path.rglob("*"))
+        assert dumped, "no profiler output written"
+
     def test_timing_breakdown_keys(self, mesh):
         from mercury_tpu.train.profile import timing_breakdown
 
