@@ -26,7 +26,8 @@ class TrainConfig:
     model: str = "resnet18"          # key into mercury_tpu.models.create_model
     dataset: str = "cifar10"         # "cifar10" | "cifar100" | "synthetic"
     num_classes: Optional[int] = None  # None → derived from dataset; set → validated
-    image_size: int = 32
+    image_size: int = 32             # ingest resize for dataset="imagefolder";
+                                     # array datasets carry their own shapes
 
     # Parallelism -----------------------------------------------------------
     world_size: int = 4              # number of data-parallel workers (mesh size)

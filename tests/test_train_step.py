@@ -201,6 +201,19 @@ class TestPipelinedScoring:
             )
         assert np.isfinite(float(m["train/loss"]))
 
+    def test_pipelined_with_pallas_kernels(self, mesh):
+        """The fused Pallas score/draw kernel must work inside the pipelined
+        path's lax.cond bootstrap."""
+        cfg = tiny_config(pipelined_scoring=True, use_pallas=True,
+                          steps_per_epoch=3)
+        tr = Trainer(cfg, mesh=mesh)
+        for _ in range(3):
+            tr.state, m = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices,
+            )
+        assert np.isfinite(float(m["train/loss"]))
+
     def test_groupwise_rejects_pipelined(self, mesh):
         cfg = tiny_config(pipelined_scoring=True, sampler="groupwise")
         with pytest.raises(ValueError, match="pipelined"):
