@@ -277,6 +277,28 @@ class TestScannedSteps:
         assert int(tr.state.step) == 7
 
 
+class TestNorthStarConfig:
+    def test_resnet50_cifar100_8worker_stat_allreduce(self, mesh):
+        """BASELINE config #5: ResNet-50, CIFAR-100 (synthetic fallback
+        keeps 100 classes), 8 workers, cross-worker importance-stat psum —
+        one full SPMD step executes and every worker sees the same EMA."""
+        cfg = TrainConfig(
+            model="resnet50", dataset="cifar100", world_size=8, batch_size=4,
+            presample_batches=2, sync_importance_stats=True, steps_per_epoch=1,
+            num_epochs=1, eval_every=0, log_every=0, compute_dtype="float32",
+            seed=0,
+        )
+        tr = Trainer(cfg, mesh=mesh)
+        assert tr.dataset.num_classes == 100
+        tr.state, m = tr.train_step(
+            tr.state, tr.dataset.x_train, tr.dataset.y_train,
+            tr.dataset.shard_indices,
+        )
+        assert np.isfinite(float(m["train/loss"]))
+        vals = np.asarray(tr.state.ema.value)
+        np.testing.assert_allclose(vals, vals[0], rtol=1e-5)
+
+
 class TestEval:
     def test_iid_eval_transform_applied(self, mesh):
         """IID config evaluates through the reference's test transform
