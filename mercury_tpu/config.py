@@ -84,6 +84,17 @@ class TrainConfig:
     # behavior — gloo workers never sync BN); "sync" psums batch stats.
     batch_norm: str = "sync"
 
+    # Gradient quantization ahead of the allreduce: "none", or "stochastic"
+    # — the unbiased sign·max·Bernoulli quantizer the reference left as dead
+    # code (`quantize_tensor`, util.py:65-70; "sparse rate" logging at
+    # pytorch_collab.py:184-185). Each worker quantizes its local gradient
+    # with an independent key, then the mean is taken across workers; the
+    # estimator stays unbiased (E[q] = g elementwise). Note this reproduces
+    # the *estimator* (convergence behavior + sparse-rate observability):
+    # the in-graph psum still moves dense tensors — XLA collectives don't
+    # exploit value sparsity, so it is not a bandwidth optimization here.
+    grad_compression: str = "none"
+
     # Bookkeeping -----------------------------------------------------------
     seed: int = 102                  # pytorch_collab.py:22
     eval_every: int = 200            # steps (pytorch_collab.py:181)

@@ -99,6 +99,9 @@ def make_train_step(
         raise ValueError("use_pallas requires label_smoothing == 0")
     if config.sampler not in ("pool", "groupwise"):
         raise ValueError(f"unknown sampler {config.sampler!r}")
+    if config.grad_compression not in ("none", "stochastic"):
+        raise ValueError(f"unknown grad_compression {config.grad_compression!r}")
+    compress_grads = config.grad_compression == "stochastic"
     use_groupwise = use_is and config.sampler == "groupwise"
     pipelined = use_is and config.pipelined_scoring
     if pipelined and use_groupwise:
@@ -163,6 +166,10 @@ def make_train_step(
         rng = state.rng[0]
         (k_stream, k_aug, k_sel, k_aug2, k_boot_stream, k_boot_aug,
          k_boot_sel, k_next) = jax.random.split(rng, 8)
+        # fold_in (not a 9-way split) so the eight existing streams — and
+        # every recorded seeded trajectory — are unchanged by the
+        # compression feature's existence.
+        k_quant = jax.random.fold_in(rng, 0x71)
 
         groupwise = None
         new_pending = None
@@ -282,6 +289,23 @@ def make_train_step(
             loss_fn, has_aux=True
         )(state.params)
 
+        # --- optional quantization: each worker stochastically quantizes
+        # its local gradient (independent keys); the mean across workers
+        # stays unbiased — the live version of the reference's dead-code
+        # experiment (util.py:65-70; "sparse rate", pytorch_collab.py:184).
+        # Estimator semantics only: the psum below still moves dense
+        # tensors (see TrainConfig.grad_compression).
+        sparse_rate = jnp.ones((), jnp.float32)
+        if compress_grads:
+            from mercury_tpu.utils.quantize import sparsity, stochastic_quantize
+
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            qkeys = jax.random.split(k_quant, len(leaves))
+            leaves = [stochastic_quantize(k, g) for k, g in zip(qkeys, leaves)]
+            grads = jax.tree_util.tree_unflatten(treedef, leaves)
+            total = float(sum(g.size for g in leaves))
+            sparse_rate = sum(sparsity(g) * (g.size / total) for g in leaves)
+
         # --- gradient allreduce (≡ average_gradients, :236-249) — in-graph
         grads = allreduce_mean_tree(grads, axis)
         loss_mean = lax.pmean(loss, axis)
@@ -320,6 +344,7 @@ def make_train_step(
             "train/loss": loss_mean,
             "train/acc": correct / count,
             "train/pool_loss": lax.pmean(avg_pool_loss, axis),
+            "train/sparse_rate": lax.pmean(sparse_rate, axis),
         }
         return new_state, metrics
 
