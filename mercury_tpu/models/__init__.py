@@ -1,4 +1,4 @@
-"""Flax model zoo — ResNet/VGG/MobileNetV2/BiLSTM-attention.
+"""Flax model zoo — ResNet/VGG/MobileNetV2/BiLSTM-attention/Transformer.
 
 ``create_model`` is the factory the trainer uses (name-keyed, like the
 reference's model selection global at ``pytorch_collab.py:25,255``).
@@ -23,6 +23,10 @@ from mercury_tpu.models.resnet import (  # noqa: F401
     ResNet152,
 )
 from mercury_tpu.models.simple import SmallCNN  # noqa: F401
+from mercury_tpu.models.transformer import (  # noqa: F401
+    TransformerBlock,
+    TransformerClassifier,
+)
 from mercury_tpu.models.vgg import CFG as VGG_CFG  # noqa: F401
 from mercury_tpu.models.vgg import VGG, make_vgg  # noqa: F401
 
@@ -48,8 +52,9 @@ def create_model(
     """Build a model by name.
 
     Names: ``resnet18/34/50/101/152``, ``vgg11/13/16/19``, ``mobilenetv2``,
-    ``bilstm_attention``. ``bn_axis_name`` enables cross-replica synced
-    BatchNorm over the given mesh axis.
+    ``bilstm_attention``, ``transformer``. ``bn_axis_name`` enables
+    cross-replica synced BatchNorm over the given mesh axis (ignored by
+    models without BN).
     """
     name = name.lower()
     cd, pd = _DTYPES[compute_dtype], _DTYPES[param_dtype]
@@ -74,4 +79,7 @@ def create_model(
     if name in ("bilstm_attention", "mylstm", "lstm"):
         return BiLSTMAttention(num_classes=num_classes, compute_dtype=cd,
                                param_dtype=pd, **kwargs)
+    if name == "transformer":
+        return TransformerClassifier(num_classes=num_classes, compute_dtype=cd,
+                                     param_dtype=pd, **kwargs)
     raise ValueError(f"unknown model {name!r}")

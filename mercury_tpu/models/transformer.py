@@ -1,0 +1,126 @@
+"""Transformer sequence classifier in Flax.
+
+An extension beyond the reference's model zoo (its only sequence model is
+the BiLSTM+attention speech net, ``pytorch_model.py:208-241``): a standard
+pre-LN Transformer encoder over ``[B, T, F]`` feature sequences, mean-pooled
+into a classification head, trainable end-to-end through the Mercury
+importance-sampled step like every other model in the zoo.
+
+Long-context path: with ``sp_axis`` set and the module applied inside a
+``shard_map`` whose sequence dimension is sharded over that mesh axis, every
+self-attention runs as blockwise **ring attention**
+(:mod:`mercury_tpu.parallel.sequence`) — K/V blocks stream around the ring
+via ``lax.ppermute`` while each device keeps only its local sequence shard,
+so context length scales with the number of devices. The LayerNorms, MLPs,
+positional embeddings, and mean-pool are position-local (the pool's sum is
+completed by the caller's ``psum``-friendly mean over the sharded axis —
+see ``tests/test_sequence_parallel.py`` for the canonical harness).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+from mercury_tpu.parallel.sequence import attention
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN encoder block: MHA (dense or ring) + GELU MLP, residual both."""
+
+    num_heads: int
+    d_model: int
+    mlp_ratio: int = 4
+    causal: bool = False
+    sp_axis: Optional[str] = None
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [B, T(_local), D]
+        b, t, _ = x.shape
+        head_dim = self.d_model // self.num_heads
+        h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+        qkv = nn.Dense(3 * self.d_model, dtype=self.compute_dtype,
+                       param_dtype=self.param_dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, self.num_heads, head_dim)
+        out = attention(q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                        causal=self.causal, sp_axis=self.sp_axis)
+        out = nn.Dense(self.d_model, dtype=self.compute_dtype,
+                       param_dtype=self.param_dtype, name="proj")(
+            out.reshape(b, t, self.d_model))
+        x = x + out
+        h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.compute_dtype,
+                     param_dtype=self.param_dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, dtype=self.compute_dtype,
+                     param_dtype=self.param_dtype)(h)
+        return x + h
+
+
+class TransformerClassifier(nn.Module):
+    """Encoder stack over feature sequences, mean-pooled into a linear head.
+
+    ``sp_axis``: mesh axis the sequence dimension is sharded over (ring
+    attention + ``psum``-completed mean pool); ``None`` = unsharded.
+    """
+
+    num_classes: int
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 2048
+    causal: bool = False
+    sp_axis: Optional[str] = None
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, T(_local), F] float
+        x = x.astype(self.compute_dtype)
+        b, t, _ = x.shape
+        x = nn.Dense(self.d_model, dtype=self.compute_dtype,
+                     param_dtype=self.param_dtype, name="embed")(x)
+        pos_table = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+            self.param_dtype,
+        )
+        if self.sp_axis is None:
+            global_len = t
+            offset = 0
+        else:
+            # Global positions for this sequence shard.
+            global_len = t * lax.axis_size(self.sp_axis)
+            offset = lax.axis_index(self.sp_axis) * t
+        if global_len > self.max_len:
+            raise ValueError(
+                f"sequence length {global_len} exceeds max_len={self.max_len}"
+            )
+        pos = lax.dynamic_slice_in_dim(
+            pos_table.astype(self.compute_dtype), offset, t, axis=0
+        )
+        x = x + pos[None]
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                num_heads=self.num_heads, d_model=self.d_model,
+                causal=self.causal, sp_axis=self.sp_axis,
+                compute_dtype=self.compute_dtype, param_dtype=self.param_dtype,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+        pooled = jnp.mean(x, axis=1)                       # [B, D] (local mean)
+        if self.sp_axis is not None:
+            # Complete the mean over the sharded sequence axis.
+            pooled = lax.pmean(pooled, self.sp_axis)
+        z = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                     param_dtype=self.param_dtype, name="head")(pooled)
+        return z.astype(jnp.float32)

@@ -1,0 +1,156 @@
+"""Sequence/context parallelism: blockwise ring attention over a mesh axis.
+
+The reference has **no** long-context machinery — its only attention is an
+LSTM pooling head (``pytorch_model.py:156-206``; SURVEY.md §5 records the
+absence). This module is a forward-looking extension so the framework
+handles long sequences the TPU-native way: the sequence axis is sharded
+across a mesh axis, and attention is computed as a ring of
+``lax.ppermute`` steps — each device holds its local query block
+permanently and streams the key/value blocks around the ring, folding each
+visiting block into a flash-style online-softmax accumulator. No device
+ever materializes the full ``[L, L]`` score matrix or the full K/V, so
+maximum sequence length scales linearly with the number of devices, and
+XLA overlaps each hop's ``ppermute`` with the current block's compute.
+
+Design notes (TPU-first):
+- the per-hop inner block attention is a pair of MXU matmuls
+  (``q·kᵀ`` and ``p·v``) over ``[L_loc, L_loc]`` tiles — large, static,
+  bfloat16-friendly;
+- the hop loop is a Python ``for`` over the static ring size, so XLA sees a
+  straight-line program it can software-pipeline (collective-permute
+  overlapped with the next block's matmuls);
+- the online-softmax state ``(acc, row_max, row_sum)`` is carried in fp32
+  regardless of input dtype for numerical parity with dense attention;
+- causal masking uses *global* positions reconstructed from
+  ``lax.axis_index``, so the sharded result matches dense attention on the
+  gathered sequence exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def dense_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Reference scaled-dot-product attention on unsharded arrays.
+
+    ``q``/``k``/``v``: ``[B, L, H, D]``. Returns ``[B, L, H, D]``. The
+    ground truth the ring implementation is tested against.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _block_fold(acc, row_max, row_sum, q, k_blk, v_blk, mask):
+    """Fold one visiting K/V block into the online-softmax state.
+
+    ``q``: [B, Lq, H, D]; ``k_blk``/``v_blk``: [B, Lk, H, D];
+    ``mask``: [Lq, Lk] bool or None. State is fp32:
+    ``acc`` [B, Lq, H, D], ``row_max``/``row_sum`` [B, H, Lq].
+    """
+    d = q.shape[-1]
+    # Both matmuls run in the input dtype (bf16 inputs → bf16 MXU tiles,
+    # exactly like dense_attention); only the carried softmax state is fp32.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    blk_max = jnp.max(scores, axis=-1)                        # [B, H, Lq]
+    new_max = jnp.maximum(row_max, blk_max)
+    # Rescale the running accumulator to the new max, then add this block.
+    correction = jnp.exp(row_max - new_max)                   # [B, H, Lq]
+    p = jnp.exp(scores - new_max[..., None])                  # [B, H, Lq, Lk]
+    blk_out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk
+    ).astype(jnp.float32)
+    acc = acc * correction.transpose(0, 2, 1)[..., None] + blk_out
+    row_sum = row_sum * correction + jnp.sum(p, axis=-1)
+    return acc, new_max, row_sum
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention over sequence shards (call inside ``shard_map``).
+
+    ``q``/``k``/``v``: ``[B, L_local, H, D]`` — this device's sequence
+    block; the global sequence is the concatenation of blocks in
+    ``axis_name`` index order. Returns the local ``[B, L_local, H, D]``
+    output block, numerically matching :func:`dense_attention` on the
+    gathered arrays.
+
+    Each of the ``W = axis_size`` hops attends the resident queries to the
+    currently visiting K/V block and then rotates K/V one step around the
+    ring (``lax.ppermute``); with ``causal=True``, blocks strictly in the
+    future are neutralized via masking on global positions. Known
+    limitation: the causal path still executes the block matmuls for
+    fully-masked future blocks — the ring is hop-synchronous, so skipping
+    them per-rank would not shorten the critical path; reclaiming that
+    ~2× needs a load-balanced (striped/zigzag) block assignment, which is
+    future work.
+    """
+    w = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, l_loc, h, d = q.shape
+
+    acc = jnp.zeros((b, l_loc, h, d), jnp.float32)
+    row_max = jnp.full((b, h, l_loc), NEG_INF, jnp.float32)
+    row_sum = jnp.zeros((b, h, l_loc), jnp.float32)
+
+    perm = [(i, (i + 1) % w) for i in range(w)]
+    k_blk, v_blk = k, v
+    pos_local = jnp.arange(l_loc)
+    for hop in range(w):
+        # After `hop` rotations, the resident block originated on rank
+        # (my - hop) mod w.
+        src = lax.rem(my - hop + w, w)
+        if causal:
+            q_pos = my * l_loc + pos_local                    # [Lq]
+            kv_pos = src * l_loc + pos_local                  # [Lk]
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        else:
+            mask = None
+        acc, row_max, row_sum = _block_fold(
+            acc, row_max, row_sum, q, k_blk, v_blk, mask
+        )
+        if hop + 1 < w:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+
+    out = acc / row_sum.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    sp_axis: Optional[str] = None,
+) -> jax.Array:
+    """Dispatcher: dense attention, or ring attention when ``sp_axis`` names
+    a mesh axis the sequence dimension is sharded over (inside
+    ``shard_map``)."""
+    if sp_axis is None:
+        return dense_attention(q, k, v, causal=causal)
+    return ring_attention(q, k, v, sp_axis, causal=causal)
