@@ -59,23 +59,23 @@ def parse_config(argv: Optional[Sequence[str]] = None) -> tuple[TrainConfig, arg
                         help="print the resolved config as JSON and exit")
     args = parser.parse_args(argv)
 
-    fields = {f.name for f in dataclasses.fields(TrainConfig)}
     kw = {}
-    for name in fields:
+    for f in dataclasses.fields(TrainConfig):
+        name, ftype = f.name, str(f.type)
         value = getattr(args, name)
         # Optional[int] fields arrive as strings from argparse; coerce.
-        if isinstance(value, str) and value.isdigit():
-            f = next(f for f in dataclasses.fields(TrainConfig) if f.name == name)
-            if "int" in str(f.type):
-                value = int(value)
-        if isinstance(value, str) and value.lower() in ("none", ""):
+        if isinstance(value, str) and value.isdigit() and "int" in ftype:
+            value = int(value)
+        # "none"/"" mean None only for Optional fields — plain-str enums
+        # legitimately use "none" as a value (e.g. grad_compression).
+        if (isinstance(value, str) and value.lower() in ("none", "")
+                and "Optional" in ftype):
             value = None
         # Optional[bool] fields (e.g. use_pallas) arrive as strings; a bare
         # string "false" would be truthy downstream.
-        if isinstance(value, str) and value.lower() in ("true", "false", "yes", "no", "1", "0"):
-            f = next(f for f in dataclasses.fields(TrainConfig) if f.name == name)
-            if "bool" in str(f.type):
-                value = value.lower() in ("true", "yes", "1")
+        if (isinstance(value, str) and "bool" in ftype
+                and value.lower() in ("true", "false", "yes", "no", "1", "0")):
+            value = value.lower() in ("true", "yes", "1")
         kw[name] = value
     return TrainConfig(**kw), args
 

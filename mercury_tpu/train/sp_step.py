@@ -1,0 +1,71 @@
+"""Long-context training step on a 2-D (data × sequence) mesh.
+
+The data-parallel Mercury step (``train/step.py``) shards *workers*; this
+step additionally shards the *sequence axis of each example* over a second
+mesh axis, with every self-attention running as blockwise ring attention
+(:mod:`mercury_tpu.parallel.sequence`). Context length then scales with the
+``seq`` axis size — no device ever holds a full sequence or an ``[L, L]``
+score matrix. The reference has no long-context machinery (SURVEY.md §5);
+this is the beyond-parity extension that makes long sequences first-class.
+
+Gradient-reduction subtlety (pinned by ``tests/test_sequence_parallel.py``):
+under ``shard_map`` with replicated (``P()``) params, JAX's autodiff
+automatically ``psum``s the parameter cotangents over **all** mesh axes.
+Summing per-sequence-shard partials over ``seq`` is exactly the chain rule,
+but over ``data`` it turns the desired mean-over-workers into a sum — so the
+local loss is ``pmean``-ed over the data axis *inside* the differentiated
+function, which pre-divides the cotangent and makes the automatic psum land
+on the true global gradient. No hand-written gradient collective is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jax import shard_map
+
+from mercury_tpu.sampling.importance import per_sample_loss
+
+
+def make_dp_sp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+) -> Callable[..., Tuple[dict, tuple, jax.Array]]:
+    """Build a jitted train step over a 2-D ``(data, seq)`` mesh.
+
+    ``model`` must be sequence-parallel-aware (``sp_axis=seq_axis`` — e.g.
+    :class:`~mercury_tpu.models.TransformerClassifier`), so its attention
+    rides the ring and its pooling completes over ``seq_axis`` internally.
+
+    Returns ``step(params, opt_state, x, y) → (params, opt_state, loss)``
+    with ``x: [B, T, F]`` sharded ``P(data, seq)``, ``y: [B]`` sharded
+    ``P(data)``, params/opt state replicated.
+    """
+
+    def local_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x, train=True)
+            # pmean over data INSIDE the grad: see module docstring.
+            return lax.pmean(jnp.mean(per_sample_loss(logits, y)), data_axis)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(data_axis, seq_axis), P(data_axis)),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0, 1))
