@@ -1,0 +1,70 @@
+"""Long-context training: ring attention on a 2-D (data × seq) mesh.
+
+The sequence axis of every example is sharded over the mesh's ``seq`` axis;
+each self-attention runs as blockwise ring attention
+(``mercury_tpu/parallel/sequence.py``) — K/V blocks stream around the ring
+via ``lax.ppermute``, no device ever holds a full sequence or an ``[L, L]``
+score matrix, so context length scales with the ``seq`` axis size. The
+reference has no long-context machinery (SURVEY.md §5); this is the
+framework's beyond-parity extension.
+
+Run (8 virtual devices, CPU):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/long_context_transformer.py
+On a real pod, drop the env vars — the mesh spans the actual chips.
+"""
+
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from mercury_tpu.models import TransformerClassifier
+from mercury_tpu.train.sp_step import make_dp_sp_train_step
+
+SEQ_LEN = 512          # global context length
+FEATURES = 16
+CLASSES = 8
+BATCH = 8
+STEPS = 30
+
+
+def main():
+    devices = jax.devices()
+    n = len(devices)
+    data_size = 2 if n >= 4 else 1
+    seq_size = n // data_size
+    assert SEQ_LEN % seq_size == 0, "seq axis must divide the context length"
+    mesh = Mesh(np.array(devices).reshape(data_size, seq_size), ("data", "seq"))
+    print(f"mesh: data={data_size} × seq={seq_size} "
+          f"({SEQ_LEN // seq_size} positions/device)")
+
+    model = TransformerClassifier(
+        num_classes=CLASSES, d_model=64, num_heads=4, num_layers=2,
+        max_len=SEQ_LEN, sp_axis="seq",
+    )
+    # Init with the dense twin (same params, no mesh needed at init time).
+    dense = TransformerClassifier(
+        num_classes=CLASSES, d_model=64, num_heads=4, num_layers=2,
+        max_len=SEQ_LEN,
+    )
+    k_data, k_init = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(k_data, (BATCH, SEQ_LEN, FEATURES), jnp.float32)
+    # Learnable labels: class = argmax over class-means of the sequence.
+    y = jnp.argmax(jnp.mean(x, axis=1)[:, :CLASSES], axis=-1)
+    params = dense.init(k_init, x, train=False)["params"]
+
+    tx = optax.adam(1e-3)
+    step = make_dp_sp_train_step(model, tx, mesh)
+    opt_state = tx.init(params)
+    for i in range(STEPS):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        if (i + 1) % 10 == 0:
+            print(f"step {i + 1:3d}  loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

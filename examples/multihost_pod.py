@@ -10,6 +10,8 @@ across slices with no code change.
 Run (every host):  python examples/multihost_pod.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
+
 import jax
 
 from mercury_tpu import TrainConfig

@@ -10,6 +10,8 @@
 Run:  python examples/pipelined_groupwise.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
+
 from mercury_tpu import TrainConfig
 from mercury_tpu.train import Trainer
 

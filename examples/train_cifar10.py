@@ -8,6 +8,8 @@ Real data: export MERCURY_TPU_DATA=/path/to/cifar-10-batches-py
 runs anywhere).
 """
 
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
+
 import jax
 
 from mercury_tpu import TrainConfig

@@ -45,9 +45,15 @@ class TransformerBlock(nn.Module):
         b, t, _ = x.shape
         head_dim = self.d_model // self.num_heads
         h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
-        qkv = nn.Dense(3 * self.d_model, dtype=self.compute_dtype,
-                       param_dtype=self.param_dtype, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # Separate q/k/v projections (not one fused qkv Dense): under tensor
+        # parallelism each [D, D] kernel column-splits on head boundaries,
+        # so no resharding is needed before the per-head attention
+        # (parallel/tensor.py; the fused layout would split mid-q/k/v).
+        proj_kw = dict(features=self.d_model, dtype=self.compute_dtype,
+                       param_dtype=self.param_dtype)
+        q = nn.Dense(name="query", **proj_kw)(h)
+        k = nn.Dense(name="key", **proj_kw)(h)
+        v = nn.Dense(name="value", **proj_kw)(h)
         shape = (b, t, self.num_heads, head_dim)
         out = attention(q.reshape(shape), k.reshape(shape), v.reshape(shape),
                         causal=self.causal, sp_axis=self.sp_axis)

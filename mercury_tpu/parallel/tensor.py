@@ -1,0 +1,74 @@
+"""Tensor parallelism for the Transformer family via GSPMD sharding.
+
+The reference has no tensor parallelism (SURVEY.md §2.5 — data parallelism
+is its only strategy); this is a beyond-parity extension done the idiomatic
+XLA way: **annotate parameter shardings on a mesh axis and let the compiler
+insert the collectives** (the scaling-book recipe), instead of hand-writing
+sharded matmuls.
+
+The layout is the standard Megatron split for a pre-LN block:
+
+- ``query``/``key``/``value`` kernels ``[D, D]`` → ``P(None, model)``
+  (column-parallel; with ``num_heads % tp == 0`` the shard boundary falls
+  on head boundaries, so the per-head attention needs no resharding),
+- attention ``proj`` kernel ``[D, D]`` → ``P(model, None)`` (row-parallel:
+  partial products psummed by XLA),
+- MLP up ``[D, 4D]`` → ``P(None, model)``, MLP down ``[4D, D]`` →
+  ``P(model, None)``,
+- LayerNorms / embeddings / head replicated.
+
+Under ``jax.jit`` with these shardings on the params (and the batch
+replicated or data-sharded on another axis), XLA partitions every matmul
+and inserts the collectives itself; numerical equivalence with the
+unsharded model and a structural bound on the number of all-reduces are
+pinned by ``tests/test_tensor_parallel.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (suffix of the flattened param path) → partition spec builder.
+_COLUMN_KERNELS = ("query/kernel", "key/kernel", "value/kernel",
+                   "Dense_0/kernel")                 # output-feature split
+_COLUMN_BIASES = ("query/bias", "key/bias", "value/bias", "Dense_0/bias")
+_ROW_KERNELS = ("proj/kernel", "Dense_1/kernel")     # input-feature split
+
+
+def _path_name(path) -> str:
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def transformer_tp_shardings(
+    params, mesh: Mesh, axis: str = "model"
+):
+    """Build a ``NamedSharding`` pytree for a
+    :class:`~mercury_tpu.models.TransformerClassifier` param tree.
+
+    Kernels inside ``block*`` get the Megatron column/row split along
+    ``axis``; everything else (embeddings, LayerNorms, classifier head) is
+    replicated. Apply with ``jax.device_put(params, shardings)`` or as
+    ``in_shardings`` of a jitted step — XLA inserts the collectives.
+    """
+
+    def spec_for(path) -> P:
+        name = _path_name(path)
+        if "block" in name:
+            if name.endswith(_COLUMN_KERNELS):
+                return P(None, axis)
+            if name.endswith(_COLUMN_BIASES):
+                return P(axis)
+            if name.endswith(_ROW_KERNELS):
+                return P(axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(mesh, spec_for(path)), params
+    )
+
+
+def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
+    """Place a param tree with the tensor-parallel layout (each device
+    holds ``1/axis_size`` of every block matmul's weights)."""
+    return jax.device_put(params, transformer_tp_shardings(params, mesh, axis))
