@@ -81,25 +81,48 @@ class TransformerClassifier(nn.Module):
     d_model: int = 128
     num_heads: int = 4
     num_layers: int = 2
+    mlp_ratio: int = 4
     max_len: int = 2048
     causal: bool = False
     sp_axis: Optional[str] = None
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
-    def __call__(self, x, train: bool = True):
-        # x: [B, T(_local), F] float
-        x = x.astype(self.compute_dtype)
-        b, t, _ = x.shape
-        x = nn.Dense(self.d_model, dtype=self.compute_dtype,
-                     param_dtype=self.param_dtype, name="embed")(x)
-        pos_table = self.param(
+    # setup-style (not @nn.compact) so `embed`/`head` are individually
+    # applyable: the pipeline-parallel runner (parallel/pipeline.py) reuses
+    # them via `model.apply(..., method=...)` and stays definitionally
+    # identical to the dense forward. Explicit `name=` keeps the param tree
+    # identical to the original compact layout.
+    def setup(self):
+        self.embed_proj = nn.Dense(self.d_model, dtype=self.compute_dtype,
+                                   param_dtype=self.param_dtype, name="embed")
+        self.pos_embed = self.param(
             "pos_embed",
             nn.initializers.normal(0.02),
             (self.max_len, self.d_model),
             self.param_dtype,
         )
+        self.blocks = [
+            TransformerBlock(
+                num_heads=self.num_heads, d_model=self.d_model,
+                mlp_ratio=self.mlp_ratio,
+                causal=self.causal, sp_axis=self.sp_axis,
+                compute_dtype=self.compute_dtype, param_dtype=self.param_dtype,
+                name=f"block{i}",
+            )
+            for i in range(self.num_layers)
+        ]
+        self.final_norm = nn.LayerNorm(dtype=self.compute_dtype,
+                                       param_dtype=self.param_dtype,
+                                       name="LayerNorm_0")
+        self.head_proj = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                                  param_dtype=self.param_dtype, name="head")
+
+    def embed(self, x):
+        """Input projection + (globally offset) positional embedding."""
+        x = x.astype(self.compute_dtype)
+        _, t, _ = x.shape
+        x = self.embed_proj(x)
         if self.sp_axis is None:
             global_len = t
             offset = 0
@@ -112,21 +135,22 @@ class TransformerClassifier(nn.Module):
                 f"sequence length {global_len} exceeds max_len={self.max_len}"
             )
         pos = lax.dynamic_slice_in_dim(
-            pos_table.astype(self.compute_dtype), offset, t, axis=0
+            self.pos_embed.astype(self.compute_dtype), offset, t, axis=0
         )
-        x = x + pos[None]
-        for i in range(self.num_layers):
-            x = TransformerBlock(
-                num_heads=self.num_heads, d_model=self.d_model,
-                causal=self.causal, sp_axis=self.sp_axis,
-                compute_dtype=self.compute_dtype, param_dtype=self.param_dtype,
-                name=f"block{i}",
-            )(x)
-        x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+        return x + pos[None]
+
+    def head(self, x):
+        """Final LayerNorm + (axis-completed) mean pool + classifier."""
+        x = self.final_norm(x)
         pooled = jnp.mean(x, axis=1)                       # [B, D] (local mean)
         if self.sp_axis is not None:
             # Complete the mean over the sharded sequence axis.
             pooled = lax.pmean(pooled, self.sp_axis)
-        z = nn.Dense(self.num_classes, dtype=self.compute_dtype,
-                     param_dtype=self.param_dtype, name="head")(pooled)
-        return z.astype(jnp.float32)
+        return self.head_proj(pooled).astype(jnp.float32)
+
+    def __call__(self, x, train: bool = True):
+        # x: [B, T(_local), F] float
+        x = self.embed(x)
+        for block in self.blocks:
+            x = block(x)
+        return self.head(x)

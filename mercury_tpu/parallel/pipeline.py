@@ -1,0 +1,167 @@
+"""Pipeline parallelism: GPipe-style staged transformer over a mesh axis.
+
+The reference's only strategy is data parallelism (SURVEY.md §2.5); this is
+a beyond-parity extension completing the parallelism matrix (dp/tp/sp/pp).
+The encoder stack is split into ``S = axis_size`` contiguous stages, each
+device holding ``num_layers/S`` blocks' params (the stacked-layer axis of
+the param tree is sharded over the ``pipe`` axis). A microbatched forward
+runs as an SPMD schedule inside ``shard_map``:
+
+- tick ``t``: every stage applies its blocks to its current activation and
+  ``lax.ppermute``s the result to the next stage;
+- stage 0 injects microbatch ``t`` (while available), the last stage
+  records a finished microbatch from tick ``S-1`` on;
+- ``M`` microbatches drain in ``M + S - 1`` ticks (the classic GPipe
+  bubble); the tick loop is a ``lax.scan``, so the whole schedule — and its
+  exact reverse for backprop — is one compiled program, differentiated by
+  JAX AD through the ``ppermute``s.
+
+Embedding/positional/head params stay replicated: their compute is cheap
+and position-local, so only the block stack is staged. Correct gradient
+scaling under ``shard_map``'s automatic replicated-cotangent ``psum`` is
+pinned numerically by ``tests/test_pipeline_parallel.py`` (one PP step ==
+one unsharded step).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jax import shard_map
+
+
+def stack_block_params(params: dict, num_layers: int) -> Tuple[dict, dict]:
+    """Split a :class:`~mercury_tpu.models.TransformerClassifier` param tree
+    into ``(stacked_blocks, rest)``.
+
+    ``stacked_blocks`` stacks ``block0..block{L-1}`` leaf-wise along a new
+    leading layer axis (shard it ``P(pipe)`` to stage the stack); ``rest``
+    is everything else (embed, pos_embed, LayerNorm, head), to stay
+    replicated.
+    """
+    blocks = [params[f"block{i}"] for i in range(num_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    rest = {k: v for k, v in params.items() if not k.startswith("block")}
+    return stacked, rest
+
+
+def unstack_block_params(stacked: dict, rest: dict) -> dict:
+    """Inverse of :func:`stack_block_params`."""
+    num_layers = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    out = dict(rest)
+    for i in range(num_layers):
+        out[f"block{i}"] = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+    return out
+
+
+def make_pp_apply(
+    model,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pipe",
+):
+    """Build a jitted pipeline-parallel forward for ``model`` (a
+    :class:`~mercury_tpu.models.TransformerClassifier` **without**
+    ``sp_axis``).
+
+    Returns ``apply(stacked_blocks, rest_params, x) → logits`` where
+    ``stacked_blocks`` is sharded ``P(axis)`` on its leading layer axis,
+    ``rest_params`` is replicated, and ``x: [B, T, F]`` is replicated
+    (``num_microbatches`` must divide ``B``). Output logits are replicated.
+    Differentiable end to end.
+    """
+    if model.sp_axis is not None:
+        raise ValueError("pipeline parallelism requires sp_axis=None")
+    num_layers = model.num_layers
+    m = num_microbatches
+
+    # Single-block applier reused for every staged layer — the same
+    # TransformerBlock class (and config) the dense model builds.
+    from mercury_tpu.models.transformer import TransformerBlock
+
+    block = TransformerBlock(
+        num_heads=model.num_heads, d_model=model.d_model,
+        mlp_ratio=model.mlp_ratio,
+        causal=model.causal, compute_dtype=model.compute_dtype,
+        param_dtype=model.param_dtype,
+    )
+
+    # Embedding/head run as the model's OWN methods on the non-block params,
+    # so the pipelined forward is definitionally the dense forward.
+    def embed(rest, x):
+        return model.apply({"params": rest}, x, method="embed")
+
+    def head(rest, h):
+        return model.apply({"params": rest}, h, method="head")
+
+    def local_apply(stacked_local, rest, x):
+        s = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        bsz, t_len, _ = x.shape
+        assert bsz % m == 0, "batch must divide into microbatches"
+        mb = bsz // m
+
+        h_mb = embed(rest, x).reshape(m, mb, t_len, model.d_model)
+
+        def apply_stage(h):
+            def body(carry, p):
+                return block.apply({"params": p}, carry), None
+
+            out, _ = lax.scan(body, h, stacked_local)
+            return out
+
+        perm = [(i, (i + 1) % s) for i in range(s)]
+        # pcast: the carries become device-varying after one tick, so their
+        # initial values must be typed as varying over the pipe axis too.
+        zeros = lax.pcast(
+            jnp.zeros((mb, t_len, model.d_model), h_mb.dtype), (axis,),
+            to="varying",
+        )
+        buf0 = lax.pcast(
+            jnp.zeros((m, mb, t_len, model.d_model), h_mb.dtype), (axis,),
+            to="varying",
+        )
+
+        def tick(carry, t):
+            prev_out, buf = carry
+            recv = lax.ppermute(prev_out, axis, perm)
+            x_in = jnp.where(idx == 0, h_mb[jnp.clip(t, 0, m - 1)], recv)
+            y = apply_stage(x_in)
+            out_idx = t - (s - 1)
+            slot = jnp.clip(out_idx, 0, m - 1)
+            keep = (idx == s - 1) & (out_idx >= 0)
+            buf = buf.at[slot].set(jnp.where(keep, y, buf[slot]))
+            return (y, buf), None
+
+        (_, buf), _ = lax.scan(tick, (zeros, buf0), jnp.arange(m + s - 1))
+        # Broadcast the last stage's results (zeros elsewhere).
+        h_out = lax.psum(jnp.where(idx == s - 1, buf, jnp.zeros_like(buf)), axis)
+        logits = head(rest, h_out.reshape(bsz, t_len, model.d_model))
+
+        # Gradient scaling: `rest` is replicated and its forward compute is
+        # executed identically on all S devices, so shard_map AD's automatic
+        # cotangent psum would return S× its true gradient; pre-dividing the
+        # (replicated) logits' contribution via pmean keeps every param's
+        # gradient exact — stacked block params are sharded (no auto-psum)
+        # and their cotangents flow through the psum above, which transposes
+        # to an identity broadcast, leaving them unscaled. Pinned by
+        # tests/test_pipeline_parallel.py.
+        return lax.pmean(logits, axis)
+
+    sharded = shard_map(
+        local_apply,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P()),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)
+
+
+def shard_stacked_blocks(stacked, mesh: Mesh, axis: str = "pipe"):
+    """Place a stacked block tree with its layer axis over the pipe axis."""
+    return jax.device_put(stacked, NamedSharding(mesh, P(axis)))
