@@ -22,6 +22,7 @@ from mercury_tpu.models.resnet import (  # noqa: F401
     ResNet101,
     ResNet152,
 )
+from mercury_tpu.models.moe import MoEMLP  # noqa: F401
 from mercury_tpu.models.simple import SmallCNN  # noqa: F401
 from mercury_tpu.models.transformer import (  # noqa: F401
     TransformerBlock,

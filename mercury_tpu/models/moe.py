@@ -1,0 +1,158 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+The reference has no MoE or expert parallelism (SURVEY.md §2.5); this is a
+beyond-parity extension completing the parallelism matrix
+(dp/tp/pp/sp/**ep**). The layer is a Switch-style top-1-routed expert MLP:
+
+- a gating projection scores ``num_experts`` experts per token; each token
+  goes to its argmax expert, output scaled by the gate probability;
+- every expert is a 2-layer GELU MLP whose weights live in stacked arrays
+  ``[E, ...]`` — shard that leading axis over a mesh axis (``ep_axis``) and
+  each device holds ``E/W`` experts;
+- under expert parallelism the dispatch is the TPU-native all-to-all: each
+  device buckets its local tokens by target expert into a fixed-capacity
+  tensor (static shapes — XLA-friendly), ``lax.all_to_all`` exchanges
+  expert-major slabs so every device receives exactly the tokens routed to
+  *its* experts, applies them, and a second all-to-all returns the outputs
+  to the tokens' home devices;
+- tokens beyond an expert's capacity are dropped (output 0 for that token,
+  the standard Switch overflow semantics); with enough capacity the EP
+  layer is numerically identical to the dense reference path, which the
+  tests pin.
+
+A load-balancing auxiliary loss (Switch eq. 4: ``E · Σ_e f_e · p̄_e``) is
+returned alongside the output.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEMLP(nn.Module):
+    """Top-1 (Switch) mixture-of-experts MLP over token features.
+
+    Call with ``x: [B, T, D]`` (or ``[N, D]``); returns ``(y, aux_loss)``
+    with ``y`` the same shape as ``x``.
+
+    ``ep_axis``: mesh axis for expert parallelism — requires being inside
+    ``shard_map`` with tokens sharded over the same axis and the stacked
+    expert params sharded ``P(ep_axis)`` on their leading axis;
+    ``num_experts`` must be divisible by the axis size. ``None`` = dense
+    (every expert computed locally, one-hot combined).
+    """
+
+    num_experts: int
+    d_model: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        e, d, h = self.num_experts, self.d_model, self.mlp_ratio * self.d_model
+        if self.ep_axis is not None:
+            # Inside shard_map each device holds its expert shard, so the
+            # declared param shapes are per-device. Initialize params with
+            # a dense twin (ep_axis=None) and shard their leading axis.
+            w = lax.axis_size(self.ep_axis)
+            if e % w:
+                raise ValueError(
+                    f"num_experts {e} not divisible by axis size {w}"
+                )
+            e = e // w
+        init = nn.initializers.lecun_normal()
+        self.gate = nn.Dense(self.num_experts, dtype=self.compute_dtype,
+                             param_dtype=self.param_dtype, name="gate")
+        self.w_up = self.param("w_up", init, (e, d, h), self.param_dtype)
+        self.b_up = self.param("b_up", nn.initializers.zeros, (e, h),
+                               self.param_dtype)
+        self.w_down = self.param("w_down", init, (e, h, d), self.param_dtype)
+        self.b_down = self.param("b_down", nn.initializers.zeros, (e, d),
+                                 self.param_dtype)
+
+    def _expert_mlp(self, w_up, b_up, w_down, b_down, tokens):
+        # tokens: [..., D] with a leading expert axis matching w_up's.
+        h = jnp.einsum("e...d,edh->e...h", tokens,
+                       w_up.astype(self.compute_dtype))
+        h = nn.gelu(h + b_up.astype(self.compute_dtype)[(slice(None),)
+                    + (None,) * (h.ndim - 2)])
+        y = jnp.einsum("e...h,ehd->e...d", h,
+                       w_down.astype(self.compute_dtype))
+        return y + b_down.astype(self.compute_dtype)[(slice(None),)
+                   + (None,) * (y.ndim - 2)]
+
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        tokens = x.reshape(-1, d).astype(self.compute_dtype)   # [N, D]
+        n = tokens.shape[0]
+        e = self.num_experts
+
+        logits = self.gate(tokens)                              # [N, E]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)                 # [N]
+        gate_val = jnp.max(probs, axis=-1)                      # [N]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+
+        # Switch load-balancing loss: E · Σ_e (fraction routed)·(mean prob).
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        if self.ep_axis is not None:
+            frac = lax.pmean(frac, self.ep_axis)
+            mean_prob = lax.pmean(mean_prob, self.ep_axis)
+        aux = e * jnp.sum(frac * mean_prob)
+
+        if self.ep_axis is None:
+            # Dense reference: every expert processes every token; one-hot
+            # combine. O(E·N) compute — the semantics EP must reproduce.
+            all_out = self._expert_mlp(
+                self.w_up, self.b_up, self.w_down, self.b_down,
+                jnp.broadcast_to(tokens, (e,) + tokens.shape),
+            )                                                   # [E, N, D]
+            y = jnp.einsum("ne,end->nd", onehot.astype(all_out.dtype), all_out)
+            y = y * gate_val[:, None].astype(y.dtype)
+            return y.reshape(orig_shape).astype(x.dtype), aux
+
+        # ---------------- expert-parallel dispatch ----------------
+        w = lax.axis_size(self.ep_axis)
+        e_loc = e // w
+        capacity = int(math.ceil(self.capacity_factor * n / e))
+
+        # Position of each token within its expert's bucket; overflow
+        # drops. Integer cumsum: a float32 count would stop incrementing
+        # exactly past 2^24 tokens.
+        onehot_i = onehot.astype(jnp.int32)
+        pos = jnp.sum(jnp.cumsum(onehot_i, axis=0) * onehot_i, axis=-1) - 1
+        keep = (pos < capacity).astype(self.compute_dtype)      # [N]
+        slot = jnp.clip(pos, 0, capacity - 1)
+
+        # Scatter local tokens into [E, C, D] buckets.
+        dispatch = jnp.zeros((e, capacity, d), self.compute_dtype)
+        dispatch = dispatch.at[expert_idx, slot].add(
+            tokens * keep[:, None]
+        )
+        # Exchange expert-major slabs: [W, E_loc, C, D] — after all_to_all
+        # the leading axis indexes the SOURCE device and E_loc are my
+        # experts.
+        dispatch = dispatch.reshape(w, e_loc, capacity, d)
+        received = lax.all_to_all(dispatch, self.ep_axis, 0, 0, tiled=False)
+
+        out = self._expert_mlp(
+            self.w_up, self.b_up, self.w_down, self.b_down,
+            received.transpose(1, 0, 2, 3).reshape(e_loc, w * capacity, d),
+        )                                                       # [E_loc, W·C, D]
+        out = out.reshape(e_loc, w, capacity, d).transpose(1, 0, 2, 3)
+
+        # Route outputs back to the tokens' home devices.
+        returned = lax.all_to_all(out, self.ep_axis, 0, 0, tiled=False)
+        returned = returned.reshape(e, capacity, d)             # my tokens'
+        y = returned[expert_idx, slot] * (keep * gate_val)[:, None]
+        return y.reshape(orig_shape).astype(x.dtype), aux

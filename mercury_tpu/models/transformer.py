@@ -93,6 +93,21 @@ class TransformerClassifier(nn.Module):
     # them via `model.apply(..., method=...)` and stays definitionally
     # identical to the dense forward. Explicit `name=` keeps the param tree
     # identical to the original compact layout.
+    @nn.nowrap
+    def make_block(self, name=None, sp_axis="inherit") -> TransformerBlock:
+        """The single source of truth for block construction — used by
+        ``setup`` and by the pipeline-parallel runner
+        (``parallel/pipeline.py``, on an unbound instance — hence
+        ``nowrap``), so the two can never drift apart on block-affecting
+        config."""
+        return TransformerBlock(
+            num_heads=self.num_heads, d_model=self.d_model,
+            mlp_ratio=self.mlp_ratio, causal=self.causal,
+            sp_axis=self.sp_axis if sp_axis == "inherit" else sp_axis,
+            compute_dtype=self.compute_dtype, param_dtype=self.param_dtype,
+            name=name,
+        )
+
     def setup(self):
         self.embed_proj = nn.Dense(self.d_model, dtype=self.compute_dtype,
                                    param_dtype=self.param_dtype, name="embed")
@@ -103,14 +118,7 @@ class TransformerClassifier(nn.Module):
             self.param_dtype,
         )
         self.blocks = [
-            TransformerBlock(
-                num_heads=self.num_heads, d_model=self.d_model,
-                mlp_ratio=self.mlp_ratio,
-                causal=self.causal, sp_axis=self.sp_axis,
-                compute_dtype=self.compute_dtype, param_dtype=self.param_dtype,
-                name=f"block{i}",
-            )
-            for i in range(self.num_layers)
+            self.make_block(name=f"block{i}") for i in range(self.num_layers)
         ]
         self.final_norm = nn.LayerNorm(dtype=self.compute_dtype,
                                        param_dtype=self.param_dtype,

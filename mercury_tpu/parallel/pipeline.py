@@ -78,18 +78,16 @@ def make_pp_apply(
     if model.sp_axis is not None:
         raise ValueError("pipeline parallelism requires sp_axis=None")
     num_layers = model.num_layers
+    stages = mesh.shape[axis]
+    if num_layers % stages:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by pipe axis size {stages}"
+        )
     m = num_microbatches
 
-    # Single-block applier reused for every staged layer — the same
-    # TransformerBlock class (and config) the dense model builds.
-    from mercury_tpu.models.transformer import TransformerBlock
-
-    block = TransformerBlock(
-        num_heads=model.num_heads, d_model=model.d_model,
-        mlp_ratio=model.mlp_ratio,
-        causal=model.causal, compute_dtype=model.compute_dtype,
-        param_dtype=model.param_dtype,
-    )
+    # Single-block applier reused for every staged layer — built by the
+    # model's own factory so block config can never drift.
+    block = model.make_block(sp_axis=None)
 
     # Embedding/head run as the model's OWN methods on the non-block params,
     # so the pipelined forward is definitionally the dense forward.
