@@ -104,6 +104,13 @@ class TrainConfig:
     checkpoint_every: int = 1000     # steps; 0 disables
     data_dir: Optional[str] = None   # where CIFAR binaries live; None → search
 
+    # Mixture-of-experts (model="transformer" only): number of Switch
+    # experts per block's MLP; None = dense MLP. The router's
+    # load-balancing aux loss enters the training objective scaled by
+    # moe_aux_weight (Switch paper's α).
+    moe_experts: Optional[int] = None
+    moe_aux_weight: float = 0.01
+
     # Precision -------------------------------------------------------------
     compute_dtype: str = "bfloat16"  # MXU-friendly activations/matmuls
     param_dtype: str = "float32"

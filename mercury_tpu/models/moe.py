@@ -44,8 +44,10 @@ class MoEMLP(nn.Module):
     ``ep_axis``: mesh axis for expert parallelism — requires being inside
     ``shard_map`` with tokens sharded over the same axis and the stacked
     expert params sharded ``P(ep_axis)`` on their leading axis;
-    ``num_experts`` must be divisible by the axis size. ``None`` = dense
-    (every expert computed locally, one-hot combined).
+    ``num_experts`` must be divisible by the axis size. ``None`` = single
+    device: same fixed-capacity bucketing (identical drop semantics, and
+    O(N·capacity_factor) compute), minus the all-to-alls. The O(E·N)
+    one-hot oracle is :meth:`reference`.
     """
 
     num_experts: int
@@ -110,20 +112,6 @@ class MoEMLP(nn.Module):
             mean_prob = lax.pmean(mean_prob, self.ep_axis)
         aux = e * jnp.sum(frac * mean_prob)
 
-        if self.ep_axis is None:
-            # Dense reference: every expert processes every token; one-hot
-            # combine. O(E·N) compute — the semantics EP must reproduce.
-            all_out = self._expert_mlp(
-                self.w_up, self.b_up, self.w_down, self.b_down,
-                jnp.broadcast_to(tokens, (e,) + tokens.shape),
-            )                                                   # [E, N, D]
-            y = jnp.einsum("ne,end->nd", onehot.astype(all_out.dtype), all_out)
-            y = y * gate_val[:, None].astype(y.dtype)
-            return y.reshape(orig_shape).astype(x.dtype), aux
-
-        # ---------------- expert-parallel dispatch ----------------
-        w = lax.axis_size(self.ep_axis)
-        e_loc = e // w
         capacity = int(math.ceil(self.capacity_factor * n / e))
 
         # Position of each token within its expert's bucket; overflow
@@ -139,6 +127,21 @@ class MoEMLP(nn.Module):
         dispatch = dispatch.at[expert_idx, slot].add(
             tokens * keep[:, None]
         )
+
+        if self.ep_axis is None:
+            # Single-device path: same bucketing (so capacity semantics
+            # match EP exactly), no exchange — each expert's MLP runs on
+            # its C bucketed tokens, O(N·capacity_factor) compute. The
+            # O(E·N) one-hot oracle lives in :meth:`reference`.
+            out = self._expert_mlp(
+                self.w_up, self.b_up, self.w_down, self.b_down, dispatch
+            )                                                   # [E, C, D]
+            y = out[expert_idx, slot] * (keep * gate_val)[:, None]
+            return y.reshape(orig_shape).astype(x.dtype), aux
+
+        # ---------------- expert-parallel dispatch ----------------
+        w = lax.axis_size(self.ep_axis)
+        e_loc = e // w
         # Exchange expert-major slabs: [W, E_loc, C, D] — after all_to_all
         # the leading axis indexes the SOURCE device and E_loc are my
         # experts.
@@ -155,4 +158,26 @@ class MoEMLP(nn.Module):
         returned = lax.all_to_all(out, self.ep_axis, 0, 0, tiled=False)
         returned = returned.reshape(e, capacity, d)             # my tokens'
         y = returned[expert_idx, slot] * (keep * gate_val)[:, None]
+        return y.reshape(orig_shape).astype(x.dtype), aux
+
+    def reference(self, x) -> Tuple[jax.Array, jax.Array]:
+        """O(E·N) one-hot oracle: every expert processes every token, the
+        routed output is selected by one-hot combine. No capacity, no
+        drops — the definitional top-1 semantics the bucketed paths are
+        tested against (``ep_axis`` must be None)."""
+        orig_shape = x.shape
+        tokens = x.reshape(-1, orig_shape[-1]).astype(self.compute_dtype)
+        e = self.num_experts
+        probs = jax.nn.softmax(
+            self.gate(tokens).astype(jnp.float32), axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)
+        gate_val = jnp.max(probs, axis=-1)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        aux = e * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+        all_out = self._expert_mlp(
+            self.w_up, self.b_up, self.w_down, self.b_down,
+            jnp.broadcast_to(tokens, (e,) + tokens.shape),
+        )                                                       # [E, N, D]
+        y = jnp.einsum("ne,end->nd", onehot.astype(all_out.dtype), all_out)
+        y = y * gate_val[:, None].astype(y.dtype)
         return y.reshape(orig_shape).astype(x.dtype), aux

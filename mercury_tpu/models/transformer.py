@@ -29,13 +29,23 @@ from mercury_tpu.parallel.sequence import attention
 
 
 class TransformerBlock(nn.Module):
-    """Pre-LN encoder block: MHA (dense or ring) + GELU MLP, residual both."""
+    """Pre-LN encoder block: MHA (dense or ring) + GELU MLP, residual both.
+
+    With ``moe_experts`` set, the MLP becomes a Switch-style
+    mixture-of-experts (:class:`~mercury_tpu.models.MoEMLP`); its
+    load-balancing aux loss is recorded via ``self.sow("losses",
+    "moe_aux", ...)`` — apply with ``mutable=["losses"]`` to collect it.
+    ``moe_ep_axis`` shards the experts over a mesh axis (expert
+    parallelism, inside ``shard_map``)."""
 
     num_heads: int
     d_model: int
     mlp_ratio: int = 4
     causal: bool = False
     sp_axis: Optional[str] = None
+    moe_experts: Optional[int] = None
+    moe_ep_axis: Optional[str] = None
+    moe_capacity_factor: float = 1.25
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -62,11 +72,23 @@ class TransformerBlock(nn.Module):
             out.reshape(b, t, self.d_model))
         x = x + out
         h = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
-        h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.compute_dtype,
-                     param_dtype=self.param_dtype)(h)
-        h = nn.gelu(h)
-        h = nn.Dense(self.d_model, dtype=self.compute_dtype,
-                     param_dtype=self.param_dtype)(h)
+        if self.moe_experts is not None:
+            from mercury_tpu.models.moe import MoEMLP
+
+            h, aux = MoEMLP(
+                num_experts=self.moe_experts, d_model=self.d_model,
+                mlp_ratio=self.mlp_ratio, ep_axis=self.moe_ep_axis,
+                capacity_factor=self.moe_capacity_factor,
+                compute_dtype=self.compute_dtype,
+                param_dtype=self.param_dtype, name="moe",
+            )(h)
+            self.sow("losses", "moe_aux", aux)
+        else:
+            h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.compute_dtype,
+                         param_dtype=self.param_dtype)(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.d_model, dtype=self.compute_dtype,
+                         param_dtype=self.param_dtype)(h)
         return x + h
 
 
@@ -85,6 +107,9 @@ class TransformerClassifier(nn.Module):
     max_len: int = 2048
     causal: bool = False
     sp_axis: Optional[str] = None
+    moe_experts: Optional[int] = None
+    moe_ep_axis: Optional[str] = None
+    moe_capacity_factor: float = 1.25
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -104,6 +129,8 @@ class TransformerClassifier(nn.Module):
             num_heads=self.num_heads, d_model=self.d_model,
             mlp_ratio=self.mlp_ratio, causal=self.causal,
             sp_axis=self.sp_axis if sp_axis == "inherit" else sp_axis,
+            moe_experts=self.moe_experts, moe_ep_axis=self.moe_ep_axis,
+            moe_capacity_factor=self.moe_capacity_factor,
             compute_dtype=self.compute_dtype, param_dtype=self.param_dtype,
             name=name,
         )

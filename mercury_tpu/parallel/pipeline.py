@@ -77,6 +77,11 @@ def make_pp_apply(
     """
     if model.sp_axis is not None:
         raise ValueError("pipeline parallelism requires sp_axis=None")
+    if model.moe_experts is not None:
+        raise ValueError(
+            "pipeline parallelism does not support MoE blocks (the sowed "
+            "aux loss does not carry through the staged scan)"
+        )
     num_layers = model.num_layers
     stages = mesh.shape[axis]
     if num_layers % stages:

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from mercury_tpu.sampling.importance import per_sample_loss
+from mercury_tpu.utils.tree import sum_sowed_losses
 
 
 def make_dp_sp_train_step(
@@ -39,6 +40,7 @@ def make_dp_sp_train_step(
     mesh: Mesh,
     data_axis: str = "data",
     seq_axis: str = "seq",
+    moe_aux_weight: float = 0.01,
 ) -> Callable[..., Tuple[dict, tuple, jax.Array]]:
     """Build a jitted train step over a 2-D ``(data, seq)`` mesh.
 
@@ -53,9 +55,17 @@ def make_dp_sp_train_step(
 
     def local_step(params, opt_state, x, y):
         def loss_fn(p):
-            logits = model.apply({"params": p}, x, train=True)
+            logits, state = model.apply(
+                {"params": p}, x, train=True, mutable=["losses"]
+            )
+            # Any sowed MoE load-balancing losses join the objective. Each
+            # seq shard sows a router aux from its local tokens — pmean it
+            # over the seq axis so the loss stays replicated (and the
+            # auto-psum of cotangents doesn't rescale the aux term).
+            aux = lax.pmean(sum_sowed_losses(state), seq_axis)
+            loss = jnp.mean(per_sample_loss(logits, y)) + moe_aux_weight * aux
             # pmean over data INSIDE the grad: see module docstring.
-            return lax.pmean(jnp.mean(per_sample_loss(logits, y)), data_axis)
+            return lax.pmean(loss, data_axis)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)

@@ -119,16 +119,27 @@ def make_train_step(
         batch statistics for normalization but discards the running-stat
         update — the clean version of the reference's quirk where
         ``update_samples``'s no_grad forwards still mutate BN running means
-        (``pytorch_collab.py:101`` runs the net in train mode)."""
+        (``pytorch_collab.py:101`` runs the net in train mode).
+
+        Returns ``(logits, new_stats, aux)`` where ``aux`` is the sum of
+        any sowed ``"losses"`` collection entries (the MoE router's
+        load-balancing loss; 0.0 for models that sow nothing)."""
         variables = {"params": params}
+        mutable = ["losses"]
         if batch_stats:
             variables["batch_stats"] = batch_stats
-            logits, new_model_state = model.apply(
-                variables, images, train=True, mutable=["batch_stats"]
-            )
-            new_stats = new_model_state["batch_stats"] if keep_stats else batch_stats
-            return logits, new_stats
-        return model.apply(variables, images, train=True), batch_stats
+            mutable = ["batch_stats", "losses"]
+        logits, new_model_state = model.apply(
+            variables, images, train=True, mutable=mutable
+        )
+        from mercury_tpu.utils.tree import sum_sowed_losses
+
+        aux = sum_sowed_losses(new_model_state)
+        if batch_stats and keep_stats:
+            new_stats = new_model_state["batch_stats"]
+        else:
+            new_stats = batch_stats
+        return logits, new_stats, aux
 
     def _augment(key, images):
         if config.augmentation == "noniid":
@@ -188,7 +199,7 @@ def make_train_step(
                 gidx = shard_indices[0][slots]
                 imgs = _augment(ka, normalize_images(x_train[gidx], mean, std))
                 labs = y_train[gidx]
-                pool_logits, _ = _apply_train(
+                pool_logits, _, _ = _apply_train(
                     state.params, state.batch_stats, imgs, False
                 )
                 pool_losses = _loss_per_sample(pool_logits, labs)
@@ -243,7 +254,7 @@ def make_train_step(
                 # --- importance scoring: ONE batched inference forward over
                 # the pool (≡ the 10-iteration no_grad loop, :95-106),
                 # batch-stat normalization, running-stat updates discarded --
-                pool_logits, _ = _apply_train(
+                pool_logits, _, _ = _apply_train(
                     state.params, state.batch_stats, images, False
                 )
                 pool_losses = _loss_per_sample(pool_logits, labels)
@@ -281,11 +292,17 @@ def make_train_step(
         # --- train forward/backward with the unbiased IS reweighting
         # mean(loss_i/(N·p_i)) (:132-148) --------------------------------
         def loss_fn(params):
-            logits, new_bs = _apply_train(params, state.batch_stats, sel_images, True)
+            logits, new_bs, aux = _apply_train(
+                params, state.batch_stats, sel_images, True
+            )
             losses = _loss_per_sample(logits, sel_labels)
-            return reweighted_loss(losses, scaled_probs), (logits, new_bs)
+            total = reweighted_loss(losses, scaled_probs)
+            if config.moe_experts is not None:
+                # Switch load-balancing term (sowed by the MoE blocks).
+                total = total + config.moe_aux_weight * aux
+            return total, (logits, new_bs, aux)
 
-        (loss, (logits, new_batch_stats)), grads = jax.value_and_grad(
+        (loss, (logits, new_batch_stats, moe_aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
 
@@ -345,6 +362,7 @@ def make_train_step(
             "train/acc": correct / count,
             "train/pool_loss": lax.pmean(avg_pool_loss, axis),
             "train/sparse_rate": lax.pmean(sparse_rate, axis),
+            "train/moe_aux": lax.pmean(moe_aux, axis),
         }
         return new_state, metrics
 

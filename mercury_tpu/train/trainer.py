@@ -102,12 +102,21 @@ class Trainer:
             )
 
         bn_axis = config.mesh_axis if config.batch_norm == "sync" else None
+        model_kw = {}
+        if config.moe_experts is not None:
+            if config.model != "transformer":
+                raise ValueError(
+                    "moe_experts requires model='transformer', got "
+                    f"{config.model!r}"
+                )
+            model_kw["moe_experts"] = config.moe_experts
         self.model = create_model(
             config.model,
             num_classes=self.dataset.num_classes,
             compute_dtype=config.compute_dtype,
             param_dtype=config.param_dtype,
             bn_axis_name=bn_axis,
+            **model_kw,
         )
 
         n_train = self.dataset.n_train

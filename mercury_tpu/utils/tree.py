@@ -70,3 +70,11 @@ def global_norm(tree: Any) -> jax.Array:
     """L2 norm over every leaf of a pytree (handy for grad diagnostics)."""
     leaves = jax.tree_util.tree_leaves(tree)
     return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))
+
+
+def sum_sowed_losses(model_state: Any) -> jax.Array:
+    """Sum every leaf of a Flax ``"losses"`` collection (e.g. the MoE
+    router's sowed load-balancing terms; ``sow`` stores tuples, which
+    ``tree_leaves`` flattens). Returns fp32 0.0 when nothing was sowed."""
+    leaves = jax.tree_util.tree_leaves(model_state.get("losses", {}))
+    return sum((jnp.sum(v) for v in leaves), jnp.zeros((), jnp.float32))

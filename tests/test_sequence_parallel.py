@@ -243,6 +243,36 @@ class TestDpSpTrainStep:
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.5
 
+    def test_moe_with_sequence_parallel_trains(self):
+        """MoE blocks (sowed aux, pmean'ed over seq) compose with the
+        dp×sp step: the loss stays replicated and training proceeds."""
+        import optax
+
+        from mercury_tpu.train.sp_step import make_dp_sp_train_step
+
+        model = TransformerClassifier(
+            num_classes=self.C, d_model=32, num_heads=2, num_layers=2,
+            max_len=self.T, sp_axis="seq", moe_experts=4,
+        )
+        dense = TransformerClassifier(
+            num_classes=self.C, d_model=32, num_heads=2, num_layers=2,
+            max_len=self.T, moe_experts=4,
+        )
+        x = jax.random.normal(jax.random.key(7), (4, self.T, self.F),
+                              jnp.float32)
+        y = jnp.arange(4) % self.C
+        params = dense.init(jax.random.key(8), x, train=False)["params"]
+        tx = optax.adam(1e-3)
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "seq"))
+        step = make_dp_sp_train_step(model, tx, mesh)
+        opt_state = tx.init(params)
+        losses = []
+        for _ in range(10):
+            params, opt_state, loss = step(params, opt_state, x, y)
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
 
 class TestTransformerTraining:
     def test_transformer_trains_through_mercury_step(self):
