@@ -1,10 +1,14 @@
-"""Long-context training: ring attention on a 2-D (data × seq) mesh.
+"""Long-context training: sequence-parallel attention on a 2-D
+(data × seq) mesh.
 
 The sequence axis of every example is sharded over the mesh's ``seq`` axis;
-each self-attention runs as blockwise ring attention
-(``mercury_tpu/parallel/sequence.py``) — K/V blocks stream around the ring
-via ``lax.ppermute``, no device ever holds a full sequence or an ``[L, L]``
-score matrix, so context length scales with the ``seq`` axis size. The
+each self-attention runs sequence-parallel
+(``mercury_tpu/parallel/sequence.py``) — by default blockwise ring
+attention (K/V blocks stream around the ring via ``lax.ppermute``, no
+device ever holds a full sequence or an ``[L, L]`` score matrix, so context
+length scales with the ``seq`` axis size), or Ulysses-style all-to-all
+attention (``--sp-impl ulysses``: one ``lax.all_to_all`` reshards
+sequence → heads, dense attention per head subset, reshard back). The
 reference has no long-context machinery (SURVEY.md §5); this is the
 framework's beyond-parity extension.
 
@@ -30,25 +34,36 @@ FEATURES = 16
 CLASSES = 8
 BATCH = 8
 STEPS = 30
+NUM_HEADS = 4
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sp-impl", choices=("ring", "ulysses"), default="ring",
+                    help="sequence-parallel attention strategy")
+    sp_impl = ap.parse_args().sp_impl
+
     devices = jax.devices()
     n = len(devices)
     data_size = 2 if n >= 4 else 1
     seq_size = n // data_size
     assert SEQ_LEN % seq_size == 0, "seq axis must divide the context length"
+    if sp_impl == "ulysses":
+        assert NUM_HEADS % seq_size == 0, \
+            "ulysses needs num_heads % seq_size == 0"
     mesh = Mesh(np.array(devices).reshape(data_size, seq_size), ("data", "seq"))
     print(f"mesh: data={data_size} × seq={seq_size} "
-          f"({SEQ_LEN // seq_size} positions/device)")
+          f"({SEQ_LEN // seq_size} positions/device, {sp_impl} attention)")
 
     model = TransformerClassifier(
-        num_classes=CLASSES, d_model=64, num_heads=4, num_layers=2,
-        max_len=SEQ_LEN, sp_axis="seq",
+        num_classes=CLASSES, d_model=64, num_heads=NUM_HEADS, num_layers=2,
+        max_len=SEQ_LEN, sp_axis="seq", sp_impl=sp_impl,
     )
     # Init with the dense twin (same params, no mesh needed at init time).
     dense = TransformerClassifier(
-        num_classes=CLASSES, d_model=64, num_heads=4, num_layers=2,
+        num_classes=CLASSES, d_model=64, num_heads=NUM_HEADS, num_layers=2,
         max_len=SEQ_LEN,
     )
     k_data, k_init = jax.random.split(jax.random.key(0))

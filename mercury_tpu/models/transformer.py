@@ -8,10 +8,13 @@ importance-sampled step like every other model in the zoo.
 
 Long-context path: with ``sp_axis`` set and the module applied inside a
 ``shard_map`` whose sequence dimension is sharded over that mesh axis, every
-self-attention runs as blockwise **ring attention**
-(:mod:`mercury_tpu.parallel.sequence`) — K/V blocks stream around the ring
-via ``lax.ppermute`` while each device keeps only its local sequence shard,
-so context length scales with the number of devices. The LayerNorms, MLPs,
+self-attention runs sequence-parallel
+(:mod:`mercury_tpu.parallel.sequence`) — by default blockwise **ring
+attention** (K/V blocks stream around the ring via ``lax.ppermute`` while
+each device keeps only its local sequence shard, so context length scales
+with the number of devices), or Ulysses-style **all-to-all attention**
+(``sp_impl="ulysses"``: reshard sequence → heads, dense attention per head
+subset, reshard back; needs ``num_heads % axis_size == 0``). The LayerNorms, MLPs,
 positional embeddings, and mean-pool are position-local (the pool's sum is
 completed by the caller's ``psum``-friendly mean over the sharded axis —
 see ``tests/test_sequence_parallel.py`` for the canonical harness).
@@ -29,7 +32,8 @@ from mercury_tpu.parallel.sequence import attention
 
 
 class TransformerBlock(nn.Module):
-    """Pre-LN encoder block: MHA (dense or ring) + GELU MLP, residual both.
+    """Pre-LN encoder block: MHA (dense, ring, or ulysses — ``sp_impl``)
+    + GELU MLP, residual both.
 
     With ``moe_experts`` set, the MLP becomes a Switch-style
     mixture-of-experts (:class:`~mercury_tpu.models.MoEMLP`); its
@@ -43,6 +47,7 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     causal: bool = False
     sp_axis: Optional[str] = None
+    sp_impl: str = "ring"
     moe_experts: Optional[int] = None
     moe_ep_axis: Optional[str] = None
     moe_capacity_factor: float = 1.25
@@ -66,7 +71,8 @@ class TransformerBlock(nn.Module):
         v = nn.Dense(name="value", **proj_kw)(h)
         shape = (b, t, self.num_heads, head_dim)
         out = attention(q.reshape(shape), k.reshape(shape), v.reshape(shape),
-                        causal=self.causal, sp_axis=self.sp_axis)
+                        causal=self.causal, sp_axis=self.sp_axis,
+                        sp_impl=self.sp_impl)
         out = nn.Dense(self.d_model, dtype=self.compute_dtype,
                        param_dtype=self.param_dtype, name="proj")(
             out.reshape(b, t, self.d_model))
@@ -95,8 +101,9 @@ class TransformerBlock(nn.Module):
 class TransformerClassifier(nn.Module):
     """Encoder stack over feature sequences, mean-pooled into a linear head.
 
-    ``sp_axis``: mesh axis the sequence dimension is sharded over (ring
-    attention + ``psum``-completed mean pool); ``None`` = unsharded.
+    ``sp_axis``: mesh axis the sequence dimension is sharded over
+    (sequence-parallel attention per ``sp_impl`` — ``"ring"`` or
+    ``"ulysses"`` — + ``psum``-completed mean pool); ``None`` = unsharded.
     """
 
     num_classes: int
@@ -107,6 +114,7 @@ class TransformerClassifier(nn.Module):
     max_len: int = 2048
     causal: bool = False
     sp_axis: Optional[str] = None
+    sp_impl: str = "ring"
     moe_experts: Optional[int] = None
     moe_ep_axis: Optional[str] = None
     moe_capacity_factor: float = 1.25
@@ -129,6 +137,7 @@ class TransformerClassifier(nn.Module):
             num_heads=self.num_heads, d_model=self.d_model,
             mlp_ratio=self.mlp_ratio, causal=self.causal,
             sp_axis=self.sp_axis if sp_axis == "inherit" else sp_axis,
+            sp_impl=self.sp_impl,
             moe_experts=self.moe_experts, moe_ep_axis=self.moe_ep_axis,
             moe_capacity_factor=self.moe_capacity_factor,
             compute_dtype=self.compute_dtype, param_dtype=self.param_dtype,

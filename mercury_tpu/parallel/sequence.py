@@ -1,4 +1,5 @@
-"""Sequence/context parallelism: blockwise ring attention over a mesh axis.
+"""Sequence/context parallelism: ring attention and Ulysses-style
+all-to-all attention over a mesh axis.
 
 The reference has **no** long-context machinery — its only attention is an
 LSTM pooling head (``pytorch_model.py:156-206``; SURVEY.md §5 records the
@@ -141,16 +142,72 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism (call
+    inside ``shard_map``).
+
+    The dual of :func:`ring_attention`: instead of streaming K/V blocks
+    around a ring, one ``lax.all_to_all`` over stacked q/k/v *reshards*
+    them from sequence-sharded ``[B, L/W, H, D]`` to head-sharded
+    ``[B, L, H/W, D]`` — every device then holds the **full sequence for a
+    subset of heads**, runs plain dense attention locally (heads are
+    embarrassingly parallel), and a second all-to-all restores sequence
+    sharding on the output. Communication is exactly two all-to-all
+    launches per attention (O(B·L·D/W) moved per device) versus the ring's
+    W ``ppermute`` hops of K/V; on an all-to-all friendly fabric (TPU ICI)
+    it trades the ring's per-hop latency for dense collectives, at the
+    cost of requiring ``H % W == 0`` and materializing per-head ``[L, L]``
+    score tiles (so max L is bounded by VMEM/HBM per head — the ring
+    stays strictly blockwise).
+
+    Numerically exact vs :func:`dense_attention` on the gathered sequence
+    (same math, same dtype path), including ``causal`` — after the first
+    all-to-all the local sequence axis IS the global one, so the standard
+    causal mask applies unchanged.
+    """
+    w = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % w != 0:
+        raise ValueError(
+            f"ulysses attention needs num_heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({w}); use ring attention otherwise"
+        )
+
+    # One collective in: q/k/v stacked → [3, B, L/W, H, D], heads (axis 3)
+    # split W-ways, sequence (axis 2) concatenated → [3, B, L, H/W, D].
+    qg, kg, vg = lax.all_to_all(
+        jnp.stack((q, k, v)), axis_name, split_axis=3, concat_axis=2,
+        tiled=True,
+    )
+    out = dense_attention(qg, kg, vg, causal=causal)
+    # One collective out: [B, L, H/W, D] → [B, L/W, H, D].
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
     sp_axis: Optional[str] = None,
+    sp_impl: str = "ring",
 ) -> jax.Array:
-    """Dispatcher: dense attention, or ring attention when ``sp_axis`` names
-    a mesh axis the sequence dimension is sharded over (inside
-    ``shard_map``)."""
+    """Dispatcher: dense attention, or sequence-parallel attention when
+    ``sp_axis`` names a mesh axis the sequence dimension is sharded over
+    (inside ``shard_map``). ``sp_impl`` picks the strategy: ``"ring"``
+    (blockwise ppermute ring — unbounded L, any head count) or
+    ``"ulysses"`` (head-resharding all-to-all — needs ``H % W == 0``)."""
     if sp_axis is None:
         return dense_attention(q, k, v, causal=causal)
-    return ring_attention(q, k, v, sp_axis, causal=causal)
+    if sp_impl == "ring":
+        return ring_attention(q, k, v, sp_axis, causal=causal)
+    if sp_impl == "ulysses":
+        return ulysses_attention(q, k, v, sp_axis, causal=causal)
+    raise ValueError(f"unknown sp_impl {sp_impl!r} (expected 'ring' or 'ulysses')")

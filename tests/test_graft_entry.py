@@ -1,0 +1,33 @@
+"""Guard the driver contract in ``__graft_entry__.py``.
+
+The driver compile-checks ``entry()`` single-chip and executes
+``dryrun_multichip(N)`` on N virtual CPU devices; a regression there fails
+the whole round silently, so pin both here (the conftest already provides
+the 8-device CPU platform the driver uses).
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (32, 10)
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    # Smallest even mesh — exercises the guard that skips the dp×sp arm.
+    graft.dryrun_multichip(2)

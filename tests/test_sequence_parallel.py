@@ -19,7 +19,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from mercury_tpu.models import TransformerClassifier
-from mercury_tpu.parallel.sequence import dense_attention, ring_attention
+from mercury_tpu.parallel.sequence import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 B, L, H, D = 2, 128, 2, 8   # global shapes; L shards 8-ways → 16 per device
 
@@ -98,6 +102,108 @@ class TestRingAttention:
         )
         np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
                                    rtol=0.1, atol=0.1)
+
+
+class TestUlyssesAttention:
+    """All-to-all (Ulysses-style) SP: reshards seq→heads, dense attention
+    locally, reshards back. Must match dense exactly (same math path);
+    needs H divisible by the axis size, so these use H=8 on 8 devices."""
+
+    HU = 8  # heads divisible by the mesh size
+
+    def _qkv(self, key, dtype=jnp.float32):
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (B, L, self.HU, D)
+        return tuple(jax.random.normal(k, shape, dtype) for k in (kq, kk, kv))
+
+    def _sharded(self, mesh, causal):
+        fn = shard_map(
+            functools.partial(ulysses_attention, axis_name="seq",
+                              causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"),
+        )
+        return jax.jit(fn)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        q, k, v = self._qkv(jax.random.key(10))
+        jitted = self._sharded(seq_mesh(), causal)
+        out = jitted(q, k, v)
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_dense(self, causal):
+        q, k, v = self._qkv(jax.random.key(11))
+        mesh = seq_mesh()
+        jitted = self._sharded(mesh, causal)
+
+        def loss_sp(q, k, v):
+            out = jitted(q, k, v)
+            return jnp.sum(out * out)
+
+        def loss_dense(q, k, v):
+            out = dense_attention(q, k, v, causal=causal)
+            return jnp.sum(out * out)
+
+        g_sp = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for gs, gd in zip(g_sp, g_dense):
+            np.testing.assert_allclose(np.asarray(gs), np.asarray(gd),
+                                       rtol=5e-5, atol=5e-5)
+
+    def test_matches_ring(self):
+        """The two SP strategies are interchangeable on the same shards."""
+        q, k, v = self._qkv(jax.random.key(12))
+        mesh = seq_mesh()
+        jitted = self._sharded(mesh, True)
+        out_u = jitted(q, k, v)
+        out_r = shard_map(
+            functools.partial(ring_attention, axis_name="seq", causal=True),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out_u), np.asarray(out_r),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rejects_indivisible_heads(self):
+        q = jnp.zeros((B, L, 2, D))  # 2 heads on an 8-way axis
+        mesh = seq_mesh()
+        fn = shard_map(
+            functools.partial(ulysses_attention, axis_name="seq",
+                              causal=False),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+        )
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(fn)(q, q, q)
+
+    def test_transformer_ulysses_matches_dense(self):
+        """sp_impl='ulysses' through the TransformerClassifier ≡ the
+        unsharded forward (4 heads on a 4-way seq axis)."""
+        kw = dict(num_classes=5, d_model=32, num_heads=4, num_layers=2,
+                  max_len=64)
+        dense_model = TransformerClassifier(**kw)
+        sp_model = TransformerClassifier(sp_axis="seq", sp_impl="ulysses",
+                                         **kw)
+        x = jax.random.normal(jax.random.key(13), (4, 64, 12), jnp.float32)
+        variables = dense_model.init(jax.random.key(14), x, train=False)
+        ref = dense_model.apply(variables, x, train=False)
+        mesh = seq_mesh(4)
+        fn = shard_map(
+            lambda v, x: sp_model.apply(v, x, train=False),
+            mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=P(),
+        )
+        out = jax.jit(fn)(variables, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
 
 
 class TestTransformerSequenceParallel:
