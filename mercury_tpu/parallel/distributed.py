@@ -18,11 +18,12 @@ replicate.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import dataclasses
+from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mercury_tpu.parallel.mesh import make_mesh
 
@@ -61,6 +62,77 @@ def process_info() -> Tuple[int, int]:
     reference's (rank, world_size) from gloo (``pytorch_collab.py:44-45``),
     but per *host*, not per worker: workers are mesh positions."""
     return jax.process_index(), jax.process_count()
+
+
+def make_global_array(value: Any, mesh: Mesh, spec: P) -> jax.Array:
+    """Host value → global ``jax.Array`` with ``NamedSharding(mesh, spec)``.
+
+    The multi-controller placement primitive: every process must call this
+    with the **identical** full value (true for anything derived
+    deterministically from the config seed — ``create_state``, the
+    partitioner); each process then keeps only its addressable shards.
+    Typed PRNG key arrays are handled by round-tripping through
+    ``key_data``/``wrap_key_data``.
+    """
+    if hasattr(value, "dtype") and jax.dtypes.issubdtype(
+        value.dtype, jax.dtypes.prng_key
+    ):
+        impl = jax.random.key_impl(value)
+        data = np.asarray(jax.random.key_data(value))
+        return jax.random.wrap_key_data(
+            _from_host(data, mesh, spec), impl=impl
+        )
+    return _from_host(np.asarray(value), mesh, spec)
+
+
+def _from_host(value: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        value.shape, sharding, lambda idx: value[idx]
+    )
+
+
+def globalize_state(state, mesh: Mesh, axis_name: str = "data"):
+    """Re-place a host-created ``MercuryState`` as global arrays on a
+    (possibly multi-process) mesh: model/optimizer state replicated,
+    per-worker sampler state (EMA/streams/RNG/groupwise/pending) sharded
+    along ``axis_name`` — the multi-controller twin of
+    ``train.step._state_specs``. Each process must hold the identical host
+    state (``create_state`` is deterministic in the seed), mirroring the
+    reference's implicit same-seed init before ``average_model``
+    (``pytorch_collab.py:84-87``)."""
+    rep = lambda t: jax.tree.map(lambda x: make_global_array(x, mesh, P()), t)
+    shd = lambda t: jax.tree.map(
+        lambda x: make_global_array(x, mesh, P(axis_name)), t
+    )
+    return state.replace(
+        step=make_global_array(state.step, mesh, P()),
+        params=rep(state.params),
+        batch_stats=rep(state.batch_stats),
+        opt_state=rep(state.opt_state),
+        ema=shd(state.ema),
+        stream=shd(state.stream),
+        rng=shd(state.rng),
+        groupwise=None if state.groupwise is None else shd(state.groupwise),
+        pending=None if state.pending is None else shd(state.pending),
+    )
+
+
+def globalize_dataset(dataset, mesh: Mesh, axis_name: str = "data"):
+    """Re-place a ``ShardedDataset``'s train-step inputs as global arrays:
+    the full train arrays replicated, the ``[W, L]`` shard-index matrix
+    sharded along ``axis_name`` (each host only stores its workers' rows
+    on its devices — the SPMD analogue of
+    ``load_partition_data_distributed_cifar10``)."""
+    return dataclasses.replace(
+        dataset,
+        x_train=make_global_array(dataset.x_train, mesh, P()),
+        y_train=make_global_array(dataset.y_train, mesh, P()),
+        shard_indices=make_global_array(dataset.shard_indices, mesh,
+                                        P(axis_name)),
+        shard_sizes=make_global_array(dataset.shard_sizes, mesh,
+                                      P(axis_name)),
+    )
 
 
 def host_worker_slice(mesh: Mesh, axis_name: str = "data") -> np.ndarray:

@@ -9,10 +9,16 @@ training resumes bit-deterministically.
 Primary backend is Orbax (the idiomatic JAX checkpointer); a msgpack
 fallback (``flax.serialization``) covers environments where Orbax's API is
 unavailable.
+
+Multi-controller runs: saving all-gathers cross-process-sharded leaves
+(collectively) and writes from process 0 only; restoring reads the file on
+every process — the checkpoint directory must therefore be shared across
+hosts (NFS/GCS) in multi-host runs.
 """
 
 from __future__ import annotations
 
+import functools
 import os
 import re
 from typing import Any, Optional, Tuple
@@ -63,11 +69,56 @@ def _rewrap_keys(template: Any, tree: Any) -> Any:
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _replicate_fn(sharding):
+    """Cached jitted identity → fully-replicated placement (an all-gather
+    for cross-process-sharded inputs). Cached per target sharding so
+    repeated checkpoint saves are compile-cache hits."""
+    return jax.jit(lambda a: a, out_shardings=sharding)
+
+
+def _host_gather(tree: Any) -> Any:
+    """``device_get`` that also works in multi-controller runs: any leaf
+    sharded across processes (not fully addressable — e.g. the per-worker
+    sampler state placed ``P("data")`` by ``globalize_state``) is first
+    resharded to fully-replicated via a jitted identity, which XLA lowers
+    to an all-gather. Every process must call this collectively — true for
+    the checkpoint cadence inside ``fit`` since all processes run the same
+    program."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            x = _replicate_fn(NamedSharding(x.sharding.mesh, P()))(x)
+        return x
+
+    return jax.device_get(jax.tree_util.tree_map(fetch, tree))
+
+
 def save_checkpoint(directory: str, state: Any, step: int) -> str:
-    """Save ``state`` under ``directory/ckpt_<step>``."""
+    """Save ``state`` under ``directory/ckpt_<step>``.
+
+    Multi-controller: all processes participate in the host gather (a
+    collective), then only process 0 writes — a shared checkpoint
+    directory sees exactly one writer."""
     os.makedirs(directory, exist_ok=True)
     path = _ckpt_path(directory, step)
-    to_save = jax.device_get(_unwrap_keys(state))
+    to_save = _host_gather(_unwrap_keys(state))
+    if jax.process_count() > 1:
+        # Multi-controller: process 0 writes msgpack (self-contained — no
+        # hidden barriers; Orbax's save runs internal cross-process syncs
+        # that would deadlock against ours when only one process calls it),
+        # then a barrier so no process can proceed to a restore before the
+        # writer is done. The barrier sits in a finally so a write failure
+        # on process 0 re-raises there instead of hanging everyone else.
+        try:
+            if jax.process_index() == 0:
+                _write_msgpack(path, to_save)
+        finally:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"mercury_ckpt_save_{step}")
+        return path
     ocp = _orbax()
     if ocp is not None:
         try:
@@ -76,11 +127,15 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
             return path
         except Exception:
             pass
+    _write_msgpack(path, to_save)
+    return path
+
+
+def _write_msgpack(path: str, to_save: Any) -> None:
     import flax.serialization
 
     with open(path + ".msgpack", "wb") as f:
         f.write(flax.serialization.to_bytes(to_save))
-    return path
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -104,7 +159,15 @@ def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     path = _ckpt_path(directory, step)
-    template_data = jax.device_get(_unwrap_keys(template))
+    # Only the template's structure/shapes/dtypes matter (the deserializer
+    # overwrites every value) — build host zeros rather than fetching (or,
+    # multi-controller, all-gathering) the live state.
+    import numpy as np
+
+    template_data = jax.tree_util.tree_map(
+        lambda x: np.zeros(np.shape(x), getattr(x, "dtype", None)),
+        _unwrap_keys(template),
+    )
     ocp = _orbax()
     if os.path.isdir(path) and ocp is not None:
         ckptr = ocp.PyTreeCheckpointer()

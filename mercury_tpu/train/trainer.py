@@ -160,6 +160,20 @@ class Trainer:
                                   if config.augmentation == "iid"
                                   else sample_shape),
         )
+        # Multi-controller (multi-host) runs: the host-created state and
+        # dataset are process-local; re-place them as global arrays over the
+        # (cross-process) mesh. Single-process runs skip this — shard_map
+        # handles placement there.
+        if jax.process_count() > 1:
+            from mercury_tpu.parallel.distributed import (
+                globalize_dataset,
+                globalize_state,
+            )
+
+            self.state = globalize_state(self.state, self.mesh, config.mesh_axis)
+            self.dataset = globalize_dataset(
+                self.dataset, self.mesh, config.mesh_axis
+            )
         self.train_step = make_train_step(
             self.model, self.tx, config, self.mesh, self.dataset.mean, self.dataset.std
         )
@@ -282,10 +296,14 @@ class Trainer:
             valid = np.stack([
                 np.arange(self._eval_batch) < p[1] for p in plan
             ])                                                       # [nb, B]
+            # Multi-controller: keep eval inputs as host arrays — jit treats
+            # them as replicated, compatible with the global params. (A
+            # committed process-local device array would conflict.)
+            conv = np.asarray if jax.process_count() > 1 else jnp.asarray
             self._eval_cache[train] = (
-                jnp.asarray(np.asarray(x)[idx]),
-                jnp.asarray(np.asarray(y)[idx]),
-                jnp.asarray(valid),
+                conv(np.asarray(x)[idx]),
+                conv(np.asarray(y)[idx]),
+                conv(valid),
             )
         return self._eval_cache[train]
 
@@ -320,4 +338,12 @@ class Trainer:
         directory = directory or self.config.checkpoint_dir
         assert directory, "no checkpoint directory configured"
         self.state, step = ckpt.restore_checkpoint(directory, self.state, step)
+        if jax.process_count() > 1:
+            # restore_checkpoint returns host-resident arrays; re-place them
+            # as global arrays over the cross-process mesh.
+            from mercury_tpu.parallel.distributed import globalize_state
+
+            self.state = globalize_state(
+                self.state, self.mesh, self.config.mesh_axis
+            )
         return step

@@ -1,0 +1,131 @@
+"""Worker process for tests/test_distributed.py.
+
+Forms one JAX distributed CPU cluster of ``NPROC`` processes × 4 virtual
+devices, builds the global mesh, and runs cross-process collectives:
+
+1. a psum of (process_index + 1) over all 8 devices — proves the collective
+   crosses the process boundary (result 12 = 4·1 + 4·2, not 4 or 8);
+2. a shard_map gradient-allreduce shaped like the train step's grad pmean,
+   with per-device distinct contributions;
+3. host_worker_slice — each host must own exactly its 4 mesh rows.
+
+Prints one ``OK <psum> <pmean> <rows>`` line on success; any assertion or
+hang is the test's failure signal.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Keep the remote-TPU plugin (sitecustomize) from claiming the backend.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax, shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+NPROC = 2
+
+
+def main(port: str, pid: int) -> None:
+    from mercury_tpu.parallel import distributed
+
+    distributed.initialize(f"127.0.0.1:{port}", NPROC, pid)
+    assert jax.process_count() == NPROC, jax.process_count()
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == NPROC * 4
+
+    me, n = distributed.process_info()
+    assert (me, n) == (pid, NPROC)
+
+    mesh = distributed.global_mesh()
+
+    # 1. psum of per-process values: every device contributes
+    #    (its process_index + 1) → 4·1 + 4·2 = 12.
+    def contrib():
+        return lax.psum(
+            jnp.float32(jax.process_index() + 1), "data"
+        )
+
+    total = shard_map(contrib, mesh=mesh, in_specs=(), out_specs=P())
+    psum_val = float(jax.jit(total)())
+    assert psum_val == 12.0, psum_val
+
+    # 2. grad-allreduce shape: each worker row holds a distinct value;
+    #    pmean must see all 8 rows across both processes. The [W, 1] input
+    #    is assembled as a global array from per-host shards — the
+    #    multi-controller version of the train step's sharded sampler state.
+    rows = np.arange(NPROC * 4, dtype=np.float32).reshape(-1, 1)
+    local_rows = rows[me * 4:(me + 1) * 4]
+    garr = jax.make_array_from_process_local_data(
+        jax.NamedSharding(mesh, P("data")), local_rows
+    )
+
+    def mean_fn(x):
+        return lax.pmean(x[0, 0], "data")
+
+    pmean = shard_map(mean_fn, mesh=mesh, in_specs=(P("data"),), out_specs=P())
+    pmean_val = float(jax.jit(pmean)(garr))
+    assert pmean_val == float(rows.mean()), pmean_val
+
+    # 3. host_worker_slice: this host's 4 contiguous mesh positions.
+    mine = distributed.host_worker_slice(mesh)
+    assert mine.shape == (4,), mine
+
+    # 4. A real Mercury train step, multi-controller: Trainer on the global
+    #    8-device mesh (globalize_state/globalize_dataset re-place the
+    #    host-created state), two fused steps + an eval — the loss is a
+    #    replicated global scalar, identical on both processes by
+    #    construction (same program, same global arrays).
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model="smallcnn", dataset="synthetic", world_size=NPROC * 4,
+        batch_size=4, presample_batches=2, steps_per_epoch=2, num_epochs=1,
+        eval_every=0, log_every=0, compute_dtype="float32", seed=0,
+    )
+    trainer = Trainer(cfg, mesh=mesh)
+    losses = []
+    for _ in range(2):
+        trainer.state, metrics = trainer.train_step(
+            trainer.state, trainer.dataset.x_train, trainer.dataset.y_train,
+            trainer.dataset.shard_indices,
+        )
+        losses.append(float(metrics["train/loss"]))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert int(trainer.state.step) == 2
+    ev = trainer.evaluate(include_train=False)
+    assert np.isfinite(ev["test/eval_loss"]), ev
+
+    # 5. Checkpoint roundtrip across processes: the save all-gathers the
+    #    cross-process-sharded sampler state (collective) and only process
+    #    0 writes; restore re-globalizes and must land on the same step.
+    ckpt_dir = os.environ["MERCURY_TEST_CKPT_DIR"]
+    trainer.save(ckpt_dir)
+    restored_step = trainer.restore(ckpt_dir)
+    assert restored_step == 2, restored_step
+    trainer.state, metrics = trainer.train_step(
+        trainer.state, trainer.dataset.x_train, trainer.dataset.y_train,
+        trainer.dataset.shard_indices,
+    )
+    post = float(metrics["train/loss"])
+    assert np.isfinite(post), post
+
+    # Full precision (hex) so the cross-process comparison is bit-for-bit.
+    print(f"OK {psum_val} {pmean_val} {mine.tolist()} "
+          f"loss={losses[-1].hex()} post={post.hex()}", flush=True)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    main(sys.argv[1], int(sys.argv[2]))

@@ -1,0 +1,64 @@
+"""Multi-process distributed backend test.
+
+Everything else in the suite simulates multi-device on ONE process; this
+test actually launches two OS processes that form a JAX distributed CPU
+cluster (2 processes × 4 virtual devices = one 8-device mesh) and run
+cross-process collectives — the closest a single host gets to the
+reference's 4-process gloo world (``pytorch_collab.py:269-292``) and the
+proof that ``parallel/distributed.py`` composes into a working multi-host
+program, not just a wrapper.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+
+WORKER = os.path.join(os.path.dirname(__file__), "_dist_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster_collectives(tmp_path):
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+    env["MERCURY_TEST_CKPT_DIR"] = str(tmp_path / "ckpt")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.join(os.path.dirname(__file__), os.pardir)]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(port), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert "OK 12.0 3.5" in out, f"worker {pid} wrong result:\n{out}"
+    # Both processes ran the same global program — the training losses
+    # (replicated global scalars, printed as float hex) must match
+    # bit-for-bit, including the post-checkpoint-restore step. (The
+    # worker-row slices legitimately differ per host: [0-3] vs [4-7].)
+    losses = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("OK")][0]
+        losses.append(line.split("loss=")[1])
+        assert ("[0, 1, 2, 3]" in line) or ("[4, 5, 6, 7]" in line), line
+    assert losses[0] == losses[1], f"losses diverge: {losses}"
