@@ -42,6 +42,11 @@ class TrainConfig:
     step_budget: float = 1e7         # stop when step×world_size exceeds this (pytorch_collab.py:71)
     weight_decay: float = 0.0
     label_smoothing: float = 0.0
+    # Gradient accumulation: each step contributes its gradient to an
+    # accumulator (optax.MultiSteps) and the parameter update applies every
+    # A-th step — effective batch A×batch_size per worker without the
+    # activation memory. steps/log/eval cadences still count microsteps.
+    grad_accum_steps: int = 1
 
     # Importance sampling ---------------------------------------------------
     use_importance_sampling: bool = True

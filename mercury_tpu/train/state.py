@@ -116,14 +116,24 @@ def make_optimizer(
     lr: float,
     total_steps: int,
     weight_decay: float = 0.0,
+    grad_accum_steps: int = 1,
 ) -> optax.GradientTransformation:
     """Adam + cosine decay — the reference's recipe: ``optim.Adam`` at
     ``0.001×world_size`` (``pytorch_collab.py:262,28``) under
     ``CosineAnnealingLR`` over the full run (``:62``). The reference steps
     its scheduler per epoch; here the schedule is per-step (smooth cosine to
     the same endpoint). ``sgd`` is provided as the uniform-baseline control.
+
+    ``grad_accum_steps=A > 1`` wraps the optimizer in ``optax.MultiSteps``:
+    each train step contributes its (mean) gradient to an accumulator and
+    the parameter update applies every A-th step — an effective batch of
+    ``A × batch_size`` per worker without the activation memory. The
+    cosine schedule then decays over actual updates (``total_steps / A``).
     """
-    schedule = optax.cosine_decay_schedule(lr, decay_steps=max(total_steps, 1))
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    updates = max(-(-total_steps // grad_accum_steps), 1)
+    schedule = optax.cosine_decay_schedule(lr, decay_steps=updates)
     if name == "adam":
         opt = optax.adam(schedule)
     elif name == "adamw":
@@ -134,4 +144,6 @@ def make_optimizer(
         raise ValueError(f"unknown optimizer {name!r}")
     if weight_decay and name == "adam":
         opt = optax.chain(optax.add_decayed_weights(weight_decay), opt)
+    if grad_accum_steps > 1:
+        opt = optax.MultiSteps(opt, every_k_schedule=grad_accum_steps)
     return opt

@@ -123,7 +123,8 @@ class Trainer:
         self.steps_per_epoch = config.steps_per_epoch or max(n_train // config.batch_size, 1)
         total_steps = self.steps_per_epoch * config.num_epochs
         self.tx = make_optimizer(
-            config.optimizer, config.lr, total_steps, config.weight_decay
+            config.optimizer, config.lr, total_steps, config.weight_decay,
+            grad_accum_steps=config.grad_accum_steps,
         )
 
         # Model-init sample and pending-batch shapes come from the dataset
