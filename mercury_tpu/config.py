@@ -47,6 +47,13 @@ class TrainConfig:
     # A-th step — effective batch A×batch_size per worker without the
     # activation memory. steps/log/eval cadences still count microsteps.
     grad_accum_steps: int = 1
+    # ZeRO-1: shard the optimizer state over the data axis. Gradients are
+    # reduce-scattered (each worker owns 1/W of the flattened parameter
+    # vector), the optimizer updates only that chunk, and the updates are
+    # all-gathered back onto the replicated params — optimizer memory and
+    # update compute drop by W with the same collective volume as a plain
+    # allreduce (reduce-scatter + all-gather IS the ring allreduce).
+    zero_sharding: bool = False
 
     # Importance sampling ---------------------------------------------------
     use_importance_sampling: bool = True

@@ -92,15 +92,18 @@ def _from_host(value: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
     )
 
 
-def globalize_state(state, mesh: Mesh, axis_name: str = "data"):
+def globalize_state(state, mesh: Mesh, axis_name: str = "data",
+                    zero_sharding: bool = False):
     """Re-place a host-created ``MercuryState`` as global arrays on a
     (possibly multi-process) mesh: model/optimizer state replicated,
     per-worker sampler state (EMA/streams/RNG/groupwise/pending) sharded
     along ``axis_name`` — the multi-controller twin of
-    ``train.step._state_specs``. Each process must hold the identical host
-    state (``create_state`` is deterministic in the seed), mirroring the
-    reference's implicit same-seed init before ``average_model``
-    (``pytorch_collab.py:84-87``)."""
+    ``train.step._state_specs``. Under ZeRO-1 (``zero_sharding``) the
+    optimizer state is chunk-sharded along ``axis_name`` too, matching the
+    step's specs (each host only materializes its workers' moment chunks).
+    Each process must hold the identical host state (``create_state`` is
+    deterministic in the seed), mirroring the reference's implicit
+    same-seed init before ``average_model`` (``pytorch_collab.py:84-87``)."""
     rep = lambda t: jax.tree.map(lambda x: make_global_array(x, mesh, P()), t)
     shd = lambda t: jax.tree.map(
         lambda x: make_global_array(x, mesh, P(axis_name)), t
@@ -109,7 +112,7 @@ def globalize_state(state, mesh: Mesh, axis_name: str = "data"):
         step=make_global_array(state.step, mesh, P()),
         params=rep(state.params),
         batch_stats=rep(state.batch_stats),
-        opt_state=rep(state.opt_state),
+        opt_state=shd(state.opt_state) if zero_sharding else rep(state.opt_state),
         ema=shd(state.ema),
         stream=shd(state.stream),
         rng=shd(state.rng),

@@ -57,6 +57,7 @@ def create_state(
     with_groupwise: bool = False,
     pending_batch_size: int = 0,
     pending_sample_shape: Optional[tuple] = None,
+    zero_sharding: bool = False,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -70,7 +71,23 @@ def create_state(
     variables = model.init(init_key, sample_batch, train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
-    opt_state = tx.init(params)
+    if zero_sharding:
+        # ZeRO-1: the optimizer runs on this worker's 1/W chunk of the
+        # flattened parameter vector, so its state is chunk-shaped,
+        # [W]-stacked here (sharded P(axis) by the step's specs).
+        from mercury_tpu.utils.tree import tree_flatten_to_vector, zero_chunk_size
+
+        pvec, _ = tree_flatten_to_vector(params)
+        chunk = zero_chunk_size(pvec.size, n_workers)
+        chunk_state = tx.init(jnp.zeros((chunk,), pvec.dtype))
+        opt_state = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(
+                jnp.asarray(x), (n_workers,) + jnp.shape(x)
+            ),
+            chunk_state,
+        )
+    else:
+        opt_state = tx.init(params)
     ema0 = init_ema()
     ema = EMAState(
         value=jnp.zeros((n_workers,), jnp.float32) + ema0.value,

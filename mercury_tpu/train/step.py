@@ -46,15 +46,18 @@ from jax import shard_map
 
 
 def _state_specs(
-    axis: str, has_groupwise: bool = False, has_pending: bool = False
+    axis: str, has_groupwise: bool = False, has_pending: bool = False,
+    zero_sharding: bool = False,
 ) -> MercuryState:
-    """PartitionSpec pytree-prefix for :class:`MercuryState`: model/opt state
-    replicated, per-worker sampler state sharded along the data axis."""
+    """PartitionSpec pytree-prefix for :class:`MercuryState`: model state
+    replicated, per-worker sampler state sharded along the data axis;
+    optimizer state sharded too under ZeRO-1 (each worker owns its chunk's
+    moments)."""
     return MercuryState(
         step=P(),
         params=P(),
         batch_stats=P(),
-        opt_state=P(),
+        opt_state=P(axis) if zero_sharding else P(),
         ema=EMAState(value=P(axis), count=P(axis)),
         stream=ShardStream(perm=P(axis), cursor=P(axis)),
         rng=P(axis),
@@ -104,6 +107,7 @@ def make_train_step(
     compress_grads = config.grad_compression == "stochastic"
     use_groupwise = use_is and config.sampler == "groupwise"
     pipelined = use_is and config.pipelined_scoring
+    zero = config.zero_sharding
     if pipelined and use_groupwise:
         raise ValueError("pipelined_scoring requires sampler='pool'")
 
@@ -323,16 +327,42 @@ def make_train_step(
             total = float(sum(g.size for g in leaves))
             sparse_rate = sum(sparsity(g) * (g.size / total) for g in leaves)
 
-        # --- gradient allreduce (≡ average_gradients, :236-249) — in-graph
-        grads = allreduce_mean_tree(grads, axis)
         loss_mean = lax.pmean(loss, axis)
         correct = lax.psum(
             jnp.sum((jnp.argmax(logits, -1) == sel_labels).astype(jnp.float32)), axis
         )
         count = lax.psum(jnp.asarray(batch_size, jnp.float32), axis)
 
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero:
+            # --- ZeRO-1: reduce-scatter the flattened gradient (each worker
+            # receives the mean of its 1/W chunk — reduce-scatter +
+            # all-gather IS the ring allreduce, util.py:280-324, so the
+            # collective volume matches average_gradients :236-249), update
+            # only that chunk's optimizer state, all-gather the updates.
+            from mercury_tpu.utils.tree import (
+                pad_to_chunks,
+                tree_flatten_to_vector,
+            )
+
+            w = lax.axis_size(axis)
+            opt_chunk = jax.tree_util.tree_map(lambda x: x[0], state.opt_state)
+            gvec, unravel = tree_flatten_to_vector(grads)
+            gchunk = lax.psum_scatter(pad_to_chunks(gvec, w), axis) / w
+            pvec, _ = tree_flatten_to_vector(state.params)
+            pchunk = pad_to_chunks(pvec, w)[lax.axis_index(axis)]
+            updates_chunk, new_opt_chunk = tx.update(gchunk, opt_chunk, pchunk)
+            uvec = lax.all_gather(updates_chunk, axis, tiled=True)[: gvec.size]
+            new_params = optax.apply_updates(state.params, unravel(uvec))
+            new_opt_state = jax.tree_util.tree_map(
+                lambda x: x[None], new_opt_chunk
+            )
+        else:
+            # --- gradient allreduce (≡ average_gradients, :236-249) in-graph
+            grads = allreduce_mean_tree(grads, axis)
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
 
         # Keep replicated BN stats replicated: under synced BN they already
         # agree; under local BN we average the running stats across workers
@@ -377,7 +407,8 @@ def make_train_step(
     else:
         fn = body
 
-    specs = _state_specs(axis, has_groupwise=use_groupwise, has_pending=pipelined)
+    specs = _state_specs(axis, has_groupwise=use_groupwise,
+                         has_pending=pipelined, zero_sharding=zero)
     sharded = shard_map(
         fn,
         mesh=mesh,

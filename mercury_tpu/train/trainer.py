@@ -160,6 +160,7 @@ class Trainer:
             pending_sample_shape=((32, 32, sample_shape[-1])
                                   if config.augmentation == "iid"
                                   else sample_shape),
+            zero_sharding=config.zero_sharding,
         )
         # Multi-controller (multi-host) runs: the host-created state and
         # dataset are process-local; re-place them as global arrays over the
@@ -171,7 +172,8 @@ class Trainer:
                 globalize_state,
             )
 
-            self.state = globalize_state(self.state, self.mesh, config.mesh_axis)
+            self.state = globalize_state(self.state, self.mesh, config.mesh_axis,
+                                         zero_sharding=config.zero_sharding)
             self.dataset = globalize_dataset(
                 self.dataset, self.mesh, config.mesh_axis
             )
@@ -345,6 +347,7 @@ class Trainer:
             from mercury_tpu.parallel.distributed import globalize_state
 
             self.state = globalize_state(
-                self.state, self.mesh, self.config.mesh_axis
+                self.state, self.mesh, self.config.mesh_axis,
+                zero_sharding=self.config.zero_sharding,
             )
         return step

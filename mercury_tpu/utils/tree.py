@@ -39,6 +39,21 @@ def tree_unflatten_from_vector(vector: jax.Array, unravel: Callable[[jax.Array],
     return unravel(vector)
 
 
+def zero_chunk_size(n: int, w: int) -> int:
+    """ZeRO-1 chunk length: a flattened ``n``-vector is zero-padded to
+    ``w × chunk`` and split one chunk per worker. The single owner of the
+    ceil-div so state init (``train.state.create_state``) and the step's
+    reduce-scatter layout (``train.step``) cannot desynchronize."""
+    return -(-n // w)
+
+
+def pad_to_chunks(vec: jax.Array, w: int) -> jax.Array:
+    """Zero-pad a 1-D vector and reshape to the ``[w, chunk]`` ZeRO layout
+    (row i = worker i's chunk)."""
+    chunk = zero_chunk_size(vec.size, w)
+    return jnp.pad(vec, (0, chunk * w - vec.size)).reshape(w, chunk)
+
+
 def flatten_arrays(arrays: Sequence[jax.Array]) -> jax.Array:
     """Concatenate a flat list of arrays into one 1-D vector
     (list-of-tensors form of ``util.py:23-25``)."""

@@ -121,9 +121,20 @@ def main(port: str, pid: int) -> None:
     post = float(metrics["train/loss"])
     assert np.isfinite(post), post
 
+    # 6. ZeRO-1 multi-controller: globalize_state places the chunk-sharded
+    #    optimizer state P("data") across processes; one step must run.
+    trainer_z = Trainer(cfg.replace(zero_sharding=True), mesh=mesh)
+    trainer_z.state, mz = trainer_z.train_step(
+        trainer_z.state, trainer_z.dataset.x_train,
+        trainer_z.dataset.y_train, trainer_z.dataset.shard_indices,
+    )
+    zloss = float(mz["train/loss"])
+    assert np.isfinite(zloss), zloss
+
     # Full precision (hex) so the cross-process comparison is bit-for-bit.
     print(f"OK {psum_val} {pmean_val} {mine.tolist()} "
-          f"loss={losses[-1].hex()} post={post.hex()}", flush=True)
+          f"loss={losses[-1].hex()} post={post.hex()} zero={zloss.hex()}",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,104 @@
+"""ZeRO-1 optimizer-state sharding (``config.zero_sharding``).
+
+Beyond-parity distributed-training capability: the gradient is
+reduce-scattered so each worker owns 1/W of the flattened parameter
+vector, the optimizer updates only that chunk (moments are chunk-shaped —
+memory and update FLOPs drop by W), and the updates are all-gathered back
+onto the replicated params. Reduce-scatter + all-gather is exactly the
+ring allreduce (``util.py:280-324``), so collective volume matches the
+plain ``pmean`` path. Pinned: numerical equivalence with the replicated
+optimizer, the sharded state shapes, end-to-end learning, and composition
+with gradient accumulation.
+"""
+
+import jax
+import numpy as np
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.parallel.mesh import host_cpu_mesh
+from mercury_tpu.train.trainer import Trainer
+
+W = 4
+
+
+def _cfg(**kw):
+    base = dict(
+        model="smallcnn", dataset="synthetic", world_size=W, batch_size=8,
+        presample_batches=2, steps_per_epoch=50, num_epochs=1,
+        eval_every=0, log_every=0, compute_dtype="float32", seed=0,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _run(cfg, steps):
+    tr = Trainer(cfg, mesh=host_cpu_mesh(W))
+    losses = []
+    for _ in range(steps):
+        tr.state, m = tr.train_step(tr.state, tr.dataset.x_train,
+                                    tr.dataset.y_train,
+                                    tr.dataset.shard_indices)
+        losses.append(float(m["train/loss"]))
+    return tr, losses
+
+
+class TestZero1:
+    def test_matches_replicated_optimizer(self):
+        """Same seed, ±zero_sharding: params after N steps must agree (the
+        chunked Adam update is elementwise — identical math, different
+        layout; only float summation order differs)."""
+        tr_rep, loss_rep = _run(_cfg(), 5)
+        tr_zero, loss_zero = _run(_cfg(zero_sharding=True), 5)
+        np.testing.assert_allclose(loss_rep, loss_zero, rtol=1e-5, atol=1e-6)
+        for a, b in zip(jax.tree.leaves(tr_rep.state.params),
+                        jax.tree.leaves(tr_zero.state.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_optimizer_state_is_chunk_sharded(self):
+        """Adam moments must be [W, ceil(P/W)] (sharded one chunk per
+        device), not parameter-shaped replicas."""
+        tr, _ = _run(_cfg(zero_sharding=True), 1)
+        n_params = sum(
+            int(np.prod(np.shape(p)))
+            for p in jax.tree.leaves(tr.state.params)
+        )
+        chunk = -(-n_params // W)
+        moment_leaves = [
+            x for x in jax.tree.leaves(tr.state.opt_state)
+            if np.shape(x) == (W, chunk)
+        ]
+        assert len(moment_leaves) >= 2, (  # Adam mu and nu
+            f"no [W={W}, chunk={chunk}] moment leaves in opt_state; shapes: "
+            f"{[np.shape(x) for x in jax.tree.leaves(tr.state.opt_state)]}"
+        )
+        for leaf in moment_leaves:
+            shard_shapes = {s.data.shape for s in leaf.addressable_shards}
+            assert shard_shapes == {(1, chunk)}, shard_shapes
+
+    def test_learns_end_to_end(self):
+        _, losses = _run(_cfg(zero_sharding=True, steps_per_epoch=60), 60)
+        assert all(np.isfinite(l) for l in losses)
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.8
+
+    def test_composes_with_grad_accum(self):
+        """MultiSteps' accumulator is chunk-shaped under ZeRO — both
+        features together still train."""
+        _, losses = _run(_cfg(zero_sharding=True, grad_accum_steps=2), 20)
+        assert all(np.isfinite(l) for l in losses)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        tr, _ = _run(_cfg(zero_sharding=True, checkpoint_dir=str(tmp_path)), 3)
+        tr.save()
+        # Advance past the checkpoint, then restore and confirm the step
+        # and a further step both work on the sharded opt state.
+        tr.state, _ = tr.train_step(tr.state, tr.dataset.x_train,
+                                    tr.dataset.y_train,
+                                    tr.dataset.shard_indices)
+        step = tr.restore()
+        assert step == 3
+        tr.state, m = tr.train_step(tr.state, tr.dataset.x_train,
+                                    tr.dataset.y_train,
+                                    tr.dataset.shard_indices)
+        assert np.isfinite(float(m["train/loss"]))
+        assert int(tr.state.step) == 4
