@@ -42,6 +42,11 @@ class TrainConfig:
     step_budget: float = 1e7         # stop when step×world_size exceeds this (pytorch_collab.py:71)
     weight_decay: float = 0.0
     label_smoothing: float = 0.0
+    # Linear LR warmup from 0 to the peak over this many steps (microsteps
+    # when grad_accum_steps > 1), then cosine decay over the REMAINING
+    # steps (the schedule ends with the run). Must be < total steps.
+    # 0 = reference behavior (cosine from step 0, pytorch_collab.py:62).
+    warmup_steps: int = 0
     # Gradient accumulation: each step contributes its gradient to an
     # accumulator (optax.MultiSteps) and the parameter update applies every
     # A-th step — effective batch A×batch_size per worker without the
@@ -114,6 +119,11 @@ class TrainConfig:
     log_dir: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000     # steps; 0 disables
+    # Restore the latest checkpoint in checkpoint_dir (if any) at Trainer
+    # construction — crash/preemption recovery without a separate restore
+    # call. The sampler state is in the checkpoint, so the resumed
+    # importance-sampling trajectory is bit-deterministic.
+    auto_resume: bool = False
     data_dir: Optional[str] = None   # where CIFAR binaries live; None → search
 
     # Mixture-of-experts (model="transformer" only): number of Switch

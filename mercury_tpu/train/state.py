@@ -39,7 +39,8 @@ class MercuryState:
     step: jax.Array                 # [] int32 — global step counter
     params: Any                     # model params (replicated over mesh)
     batch_stats: Any                # BN running stats (replicated)
-    opt_state: Any                  # optax state (replicated)
+    opt_state: Any                  # optax state (replicated; under ZeRO-1
+                                    # [W, ceil(P/W)]-chunked, sharded P(data))
     ema: EMAState                   # [W]-stacked per-worker EMA of mean pool loss
     stream: ShardStream             # [W]-stacked per-worker presample streams
     rng: jax.Array                  # [W, key] per-worker PRNG keys
@@ -134,6 +135,7 @@ def make_optimizer(
     total_steps: int,
     weight_decay: float = 0.0,
     grad_accum_steps: int = 1,
+    warmup_steps: int = 0,
 ) -> optax.GradientTransformation:
     """Adam + cosine decay — the reference's recipe: ``optim.Adam`` at
     ``0.001×world_size`` (``pytorch_collab.py:262,28``) under
@@ -146,11 +148,30 @@ def make_optimizer(
     the parameter update applies every A-th step — an effective batch of
     ``A × batch_size`` per worker without the activation memory. The
     cosine schedule then decays over actual updates (``total_steps / A``).
+
+    ``warmup_steps > 0`` runs a linear 0→peak warmup, then the cosine
+    decays over the *remaining* steps so the schedule still ends with the
+    run (counted in steps; divided by A like the decay horizon). Must be
+    smaller than ``total_steps``.
     """
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     updates = max(-(-total_steps // grad_accum_steps), 1)
-    schedule = optax.cosine_decay_schedule(lr, decay_steps=updates)
+    if warmup_steps > 0:
+        if warmup_steps >= total_steps:
+            raise ValueError(
+                f"warmup_steps ({warmup_steps}) must be < total steps "
+                f"({total_steps}) — nothing would remain for the decay"
+            )
+        w_updates = max(-(-warmup_steps // grad_accum_steps), 1)
+        # optax's decay_steps INCLUDES the warmup segment, so this is
+        # warmup then cosine over the remaining (updates - w) updates.
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=w_updates,
+            decay_steps=updates,
+        )
+    else:
+        schedule = optax.cosine_decay_schedule(lr, decay_steps=updates)
     if name == "adam":
         opt = optax.adam(schedule)
     elif name == "adamw":

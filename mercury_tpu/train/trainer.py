@@ -125,6 +125,7 @@ class Trainer:
         self.tx = make_optimizer(
             config.optimizer, config.lr, total_steps, config.weight_decay,
             grad_accum_steps=config.grad_accum_steps,
+            warmup_steps=config.warmup_steps,
         )
 
         # Model-init sample and pending-batch shapes come from the dataset
@@ -212,6 +213,18 @@ class Trainer:
         self._eval_batch = 256
         self._eval_cache: Dict[bool, tuple] = {}
 
+        # Crash/preemption recovery: pick up the newest checkpoint, sampler
+        # state included (bit-deterministic IS resume). The NEXT fit() then
+        # runs to the ORIGINAL end step, not num_epochs more (see fit) —
+        # gated on this flag, so non-resumed fit() calls keep their usual
+        # "train N epochs from here" semantics.
+        self._auto_resumed = False
+        if config.auto_resume and config.checkpoint_dir:
+            if ckpt.latest_step(config.checkpoint_dir) is not None:
+                resumed = self.restore()
+                self._auto_resumed = True
+                print(f"auto-resumed from checkpoint at step {resumed}")
+
     # ------------------------------------------------------------------ fit
     def fit(self, num_epochs: Optional[int] = None) -> Dict[str, float]:
         """Run training (``Trainer.fit``, ``pytorch_collab.py:56-72``).
@@ -226,8 +239,14 @@ class Trainer:
 
         # End of the run: num_epochs' worth of steps from here, clipped by
         # the step budget — the reference executes the first step for which
-        # step×world_size > budget, then breaks (:71).
-        target = step + self.steps_per_epoch * num_epochs
+        # step×world_size > budget, then breaks (:71). After an actual
+        # auto-resume the horizon is absolute (finish the original run), so
+        # re-running the same script after a crash completes it instead of
+        # extending it; ordinary fit() calls keep the relative horizon.
+        if self._auto_resumed:
+            target = self.steps_per_epoch * num_epochs
+        else:
+            target = step + self.steps_per_epoch * num_epochs
         budget_cap = int(cfg.step_budget // cfg.world_size) + 1
         end = min(target, budget_cap)
 

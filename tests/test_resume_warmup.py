@@ -1,0 +1,122 @@
+"""LR warmup (``config.warmup_steps``) and crash recovery
+(``config.auto_resume``).
+
+The reference has neither (cosine from step 0, ``pytorch_collab.py:62``;
+no checkpointing at all — SURVEY.md §5). Warmup is pinned at the schedule
+level; auto-resume is pinned as the real workflow: train, "crash", rebuild
+the same Trainer, and confirm it continues from the checkpoint to the
+original horizon with a bit-identical sampler trajectory.
+"""
+
+import jax
+import numpy as np
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.parallel.mesh import host_cpu_mesh
+from mercury_tpu.train.state import make_optimizer
+from mercury_tpu.train.trainer import Trainer
+
+W = 4
+
+
+def _cfg(**kw):
+    base = dict(
+        model="smallcnn", dataset="synthetic", world_size=W, batch_size=8,
+        presample_batches=2, steps_per_epoch=10, num_epochs=1,
+        eval_every=0, log_every=0, compute_dtype="float32", seed=0,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def test_warmup_schedule_shape():
+    import optax
+
+    # Probe the schedule through the optimizer's hyperparams indirectly:
+    # rebuild the same schedule and check endpoints.
+    lr = 0.01
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=lr, warmup_steps=10, decay_steps=100)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), lr, rtol=1e-6)
+    assert float(sched(100)) < lr * 0.01
+
+    # And that make_optimizer with warmup actually produces near-zero first
+    # updates vs the no-warmup optimizer (sgd: update = -lr_t * grad).
+    params = {"w": np.ones(4, np.float32)}
+    grads = {"w": np.ones(4, np.float32)}
+    warm = make_optimizer("sgd", lr, total_steps=100, warmup_steps=10)
+    cold = make_optimizer("sgd", lr, total_steps=100)
+    uw, _ = warm.update(grads, warm.init(params), params)
+    uc, _ = cold.update(grads, cold.init(params), params)
+    assert abs(float(uw["w"][0])) < abs(float(uc["w"][0])) * 0.2
+
+
+def test_training_with_warmup_learns():
+    cfg = _cfg(warmup_steps=20, steps_per_epoch=80)
+    tr = Trainer(cfg, mesh=host_cpu_mesh(W))
+    losses = []
+    for _ in range(80):
+        tr.state, m = tr.train_step(tr.state, tr.dataset.x_train,
+                                    tr.dataset.y_train,
+                                    tr.dataset.shard_indices)
+        losses.append(float(m["train/loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+
+class TestAutoResume:
+    def test_resume_continues_to_original_horizon(self, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        cfg = _cfg(checkpoint_dir=ckpt_dir, checkpoint_every=5,
+                   auto_resume=True, steps_per_epoch=10)
+        mesh = host_cpu_mesh(W)
+
+        # Run 1: "crashes" after 6 steps (checkpoint exists at step 5).
+        tr1 = Trainer(cfg, mesh=mesh)
+        for _ in range(6):
+            tr1.state, _ = tr1.train_step(
+                tr1.state, tr1.dataset.x_train, tr1.dataset.y_train,
+                tr1.dataset.shard_indices)
+        tr1.save()  # simulate the cadence checkpoint at the crash point
+
+        # Run 2: same config/script — must resume at 6 and stop at 10
+        # (the original horizon), not train 10 more.
+        tr2 = Trainer(cfg, mesh=mesh)
+        assert int(tr2.state.step) == 6
+        tr2.fit()
+        assert int(tr2.state.step) == 10
+
+    def test_resumed_trajectory_is_bit_identical(self, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        cfg = _cfg(checkpoint_dir=ckpt_dir, checkpoint_every=0,
+                   auto_resume=True)
+        mesh = host_cpu_mesh(W)
+
+        # Uninterrupted: 6 steps.
+        tr_a = Trainer(cfg.replace(checkpoint_dir=None, auto_resume=False),
+                       mesh=mesh)
+        for _ in range(6):
+            tr_a.state, ma = tr_a.train_step(
+                tr_a.state, tr_a.dataset.x_train, tr_a.dataset.y_train,
+                tr_a.dataset.shard_indices)
+
+        # Interrupted at 3 + resumed for 3: same final state.
+        tr_b = Trainer(cfg, mesh=mesh)
+        for _ in range(3):
+            tr_b.state, _ = tr_b.train_step(
+                tr_b.state, tr_b.dataset.x_train, tr_b.dataset.y_train,
+                tr_b.dataset.shard_indices)
+        tr_b.save()
+        tr_c = Trainer(cfg, mesh=mesh)
+        assert int(tr_c.state.step) == 3
+        for _ in range(3):
+            tr_c.state, mc = tr_c.train_step(
+                tr_c.state, tr_c.dataset.x_train, tr_c.dataset.y_train,
+                tr_c.dataset.shard_indices)
+
+        np.testing.assert_array_equal(
+            np.asarray(ma["train/loss"]), np.asarray(mc["train/loss"]))
+        for a, b in zip(jax.tree.leaves(tr_a.state.params),
+                        jax.tree.leaves(tr_c.state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
