@@ -158,12 +158,17 @@ def make_optimizer(
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     updates = max(-(-total_steps // grad_accum_steps), 1)
     if warmup_steps > 0:
-        if warmup_steps >= total_steps:
-            raise ValueError(
-                f"warmup_steps ({warmup_steps}) must be < total steps "
-                f"({total_steps}) — nothing would remain for the decay"
-            )
         w_updates = max(-(-warmup_steps // grad_accum_steps), 1)
+        # Compare post-division (update-count) values: with accumulation,
+        # ceil(warmup/A) can collide with ceil(total/A) even when
+        # warmup_steps < total_steps, which would leave optax a zero-length
+        # cosine segment.
+        if w_updates >= updates:
+            raise ValueError(
+                f"warmup_steps ({warmup_steps}) must leave decay room after "
+                f"accumulation: warmup updates ({w_updates}) >= total "
+                f"updates ({updates})"
+            )
         # optax's decay_steps INCLUDES the warmup segment, so this is
         # warmup then cosine over the remaining (updates - w) updates.
         schedule = optax.warmup_cosine_decay_schedule(

@@ -245,6 +245,9 @@ class Trainer:
         # extending it; ordinary fit() calls keep the relative horizon.
         if self._auto_resumed:
             target = self.steps_per_epoch * num_epochs
+            # Consumed: the absolute horizon applies only to the first
+            # fit() after the resume; later calls are ordinary.
+            self._auto_resumed = False
         else:
             target = step + self.steps_per_epoch * num_epochs
         budget_cap = int(cfg.step_budget // cfg.world_size) + 1
