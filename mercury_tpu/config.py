@@ -101,15 +101,18 @@ class TrainConfig:
     # behavior — gloo workers never sync BN); "sync" psums batch stats.
     batch_norm: str = "sync"
 
-    # Gradient quantization ahead of the allreduce: "none", or "stochastic"
-    # — the unbiased sign·max·Bernoulli quantizer the reference left as dead
-    # code (`quantize_tensor`, util.py:65-70; "sparse rate" logging at
-    # pytorch_collab.py:184-185). Each worker quantizes its local gradient
-    # with an independent key, then the mean is taken across workers; the
-    # estimator stays unbiased (E[q] = g elementwise). Note this reproduces
-    # the *estimator* (convergence behavior + sparse-rate observability):
-    # the in-graph psum still moves dense tensors — XLA collectives don't
-    # exploit value sparsity, so it is not a bandwidth optimization here.
+    # Gradient compression:
+    # - "stochastic": the unbiased sign·max·Bernoulli quantizer the
+    #   reference left as dead code (`quantize_tensor`, util.py:65-70;
+    #   "sparse rate" logging at pytorch_collab.py:184-185), applied
+    #   per-worker BEFORE the psum. Estimator semantics only — the psum
+    #   still moves dense f32 (XLA collectives don't exploit value
+    #   sparsity).
+    # - "int8": a genuinely bandwidth-compressed allreduce — both wire
+    #   phases (all-to-all reduce-scatter + all-gather) move int8 payloads
+    #   with per-chunk scales and stochastic rounding (unbiased), 4× fewer
+    #   bytes than the f32 psum (parallel/collectives.py
+    #   `compressed_allreduce_mean`). Not composable with zero_sharding.
     grad_compression: str = "none"
 
     # Bookkeeping -----------------------------------------------------------
@@ -132,6 +135,11 @@ class TrainConfig:
     # moe_aux_weight (Switch paper's α).
     moe_experts: Optional[int] = None
     moe_aux_weight: float = 0.01
+
+    # Activation rematerialization (model="transformer" only): recompute
+    # block activations in the backward pass (jax.checkpoint) — ~1 extra
+    # forward of FLOPs for O(layers) less activation memory.
+    remat: bool = False
 
     # Precision -------------------------------------------------------------
     compute_dtype: str = "bfloat16"  # MXU-friendly activations/matmuls

@@ -118,6 +118,11 @@ class TransformerClassifier(nn.Module):
     moe_experts: Optional[int] = None
     moe_ep_axis: Optional[str] = None
     moe_capacity_factor: float = 1.25
+    # Activation rematerialization: recompute each block's activations in
+    # the backward pass instead of storing them (jax.checkpoint via
+    # nn.remat) — trades ~1 extra forward of FLOPs for O(layers) less
+    # activation memory, the standard long-context lever.
+    remat: bool = False
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -133,7 +138,10 @@ class TransformerClassifier(nn.Module):
         (``parallel/pipeline.py``, on an unbound instance — hence
         ``nowrap``), so the two can never drift apart on block-affecting
         config."""
-        return TransformerBlock(
+        # nn.remat lifts the whole block: its forward recomputes during
+        # backprop (same params/variables tree, same numerics).
+        cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
+        return cls(
             num_heads=self.num_heads, d_model=self.d_model,
             mlp_ratio=self.mlp_ratio, causal=self.causal,
             sp_axis=self.sp_axis if sp_axis == "inherit" else sp_axis,

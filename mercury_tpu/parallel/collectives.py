@@ -94,6 +94,80 @@ def ring_allreduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
     return chunks.reshape(-1)[:n].reshape(orig_shape)  # re-cat (util.py:324)
 
 
+def _stochastic_round(key: jax.Array, y: jax.Array) -> jax.Array:
+    """Unbiased rounding to the int8 grid: E[round(y)] = y for y in range."""
+    lo = jnp.floor(y)
+    frac = y - lo
+    r = jax.random.uniform(key, y.shape)
+    return jnp.clip(lo + (r < frac), -127, 127).astype(jnp.int8)
+
+
+def _quantize_rows(key: jax.Array, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-row int8 quantization: returns ``(q int8 [R, C], scale f32 [R, 1])``
+    with E[q·scale] = x."""
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=1, keepdims=True), 1e-30) / 127.0
+    return _stochastic_round(key, x / scale), scale
+
+
+def compressed_allreduce_mean(
+    vec: jax.Array, axis_name: str, axis_size: int, key: jax.Array
+) -> jax.Array:
+    """Bandwidth-compressed allreduce-mean of a 1-D f32 vector: both wire
+    phases move **int8** payloads (4× fewer bytes than the f32 psum).
+
+    Inside ``shard_map``:
+
+    1. split the vector into W chunks, int8-quantize each (per-chunk scale,
+       stochastic rounding — unbiased);
+    2. ``all_to_all``: worker w receives every worker's version of chunk w
+       (reduce-scatter phase, int8 on the wire);
+    3. dequantize + mean in f32 (accumulation is NOT quantized — no error
+       compounding across workers, unlike quantized-accumulation rings);
+    4. re-quantize the reduced chunk and ``all_gather`` it back (all-gather
+       phase, int8 on the wire); dequantize.
+
+    Two stochastic roundings ⇒ the estimator is unbiased:
+    E[result] = mean_w(vec_w) exactly. Wire cost: 2·(W−1)/W·C bytes of int8
+    per device vs the same count of f32 — the reference's dead-code
+    quantization experiment (``quantize_tensor``, ``util.py:65-70``) made
+    real, and on the actual wire rather than pre-psum (compare
+    ``config.grad_compression="stochastic"``, estimator-only).
+    """
+    if axis_size == 1:
+        return vec
+    k1, k2 = jax.random.split(key)
+    n = vec.shape[0]
+    chunk = -(-n // axis_size)
+    rows = jnp.pad(vec, (0, chunk * axis_size - n)).reshape(axis_size, chunk)
+
+    q, scale = _quantize_rows(k1, rows)                     # [W, C] i8, [W, 1]
+    # Reduce-scatter phase: worker w ends up with all W versions of row w.
+    q_all = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)                      # [W, C] i8
+    s_all = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)                      # [W, 1]
+    mine = jnp.mean(q_all.astype(jnp.float32) * s_all, axis=0)  # [C] f32
+
+    # All-gather phase: re-quantize the reduced chunk, share int8 + scale.
+    my_q, my_scale = _quantize_rows(k2, mine[None])         # [1, C] i8, [1, 1]
+    gq = lax.all_gather(my_q[0], axis_name)                 # [W, C] i8
+    gs = lax.all_gather(my_scale[0, 0], axis_name)          # [W]
+    out = gq.astype(jnp.float32) * gs[:, None]              # [W, C] f32
+    return out.reshape(-1)[:n]
+
+
+def compressed_allreduce_mean_tree(
+    tree: Any, axis_name: str, axis_size: int, key: jax.Array
+) -> Any:
+    """:func:`compressed_allreduce_mean` over a pytree (flatten → one
+    compressed collective → unflatten) — the drop-in int8 replacement for
+    :func:`allreduce_mean_tree` on gradients."""
+    from mercury_tpu.utils.tree import tree_flatten_to_vector
+
+    vec, unravel = tree_flatten_to_vector(tree)
+    return unravel(compressed_allreduce_mean(vec, axis_name, axis_size, key))
+
+
 def ring_allreduce_sharded(mesh: Mesh, x: jax.Array, axis_name: str = "data") -> jax.Array:
     """Convenience wrapper: run :func:`ring_allreduce` on a replicated array
     under ``shard_map`` over ``mesh`` and return the summed result."""

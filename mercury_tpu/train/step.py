@@ -102,9 +102,15 @@ def make_train_step(
         raise ValueError("use_pallas requires label_smoothing == 0")
     if config.sampler not in ("pool", "groupwise"):
         raise ValueError(f"unknown sampler {config.sampler!r}")
-    if config.grad_compression not in ("none", "stochastic"):
+    if config.grad_compression not in ("none", "stochastic", "int8"):
         raise ValueError(f"unknown grad_compression {config.grad_compression!r}")
     compress_grads = config.grad_compression == "stochastic"
+    int8_allreduce = config.grad_compression == "int8"
+    if int8_allreduce and config.zero_sharding:
+        raise ValueError(
+            "grad_compression='int8' replaces the allreduce; it does not "
+            "compose with zero_sharding's reduce-scatter path"
+        )
     use_groupwise = use_is and config.sampler == "groupwise"
     pipelined = use_is and config.pipelined_scoring
     zero = config.zero_sharding
@@ -358,7 +364,18 @@ def make_train_step(
             )
         else:
             # --- gradient allreduce (≡ average_gradients, :236-249) in-graph
-            grads = allreduce_mean_tree(grads, axis)
+            if int8_allreduce:
+                # int8 on the wire, both phases (collectives.py); unbiased.
+                from mercury_tpu.parallel.collectives import (
+                    compressed_allreduce_mean_tree,
+                )
+
+                grads = compressed_allreduce_mean_tree(
+                    grads, axis, lax.axis_size(axis),
+                    jax.random.fold_in(rng, 0x72),
+                )
+            else:
+                grads = allreduce_mean_tree(grads, axis)
             updates, new_opt_state = tx.update(
                 grads, state.opt_state, state.params
             )

@@ -110,6 +110,12 @@ class Trainer:
                     f"{config.model!r}"
                 )
             model_kw["moe_experts"] = config.moe_experts
+        if config.remat:
+            if config.model != "transformer":
+                raise ValueError(
+                    f"remat requires model='transformer', got {config.model!r}"
+                )
+            model_kw["remat"] = True
         self.model = create_model(
             config.model,
             num_classes=self.dataset.num_classes,

@@ -1,0 +1,133 @@
+"""int8-compressed allreduce (``grad_compression="int8"``).
+
+Unlike the reference's dead-code quantizer (estimator-only), this one
+compresses the actual wire traffic: both collective phases move int8
+payloads with per-chunk scales and stochastic rounding. Pinned: exactness
+on grid-representable values, unbiasedness statistically, int8 types in
+the compiled HLO collectives, and end-to-end training.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mercury_tpu.parallel.collectives import compressed_allreduce_mean
+
+W = 8
+N = 1000  # deliberately not divisible by W — exercises the padding
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:W]), ("data",))
+
+
+def _run(vecs, key):
+    """vecs: [W, N] — per-worker vectors; returns each worker's result."""
+    fn = shard_map(
+        lambda v, k: compressed_allreduce_mean(
+            v[0], "data", W, k[0])[None],
+        mesh=_mesh(),
+        in_specs=(P("data"), P("data")),
+        out_specs=P("data"),
+        check_vma=False,
+    )
+    keys = jax.random.split(key, W)
+    return jax.jit(fn)(vecs, keys)
+
+
+class TestCompressedAllreduce:
+    def test_exact_on_grid_values(self):
+        """When every worker holds the same integer vector and every chunk
+        contains a ±127 (so both stages' scales are exactly 1), both
+        quantizations are lossless and the result is the exact mean on
+        every worker."""
+        rng = np.random.default_rng(0)
+        v = rng.integers(-127, 128, size=N).astype(np.float32)
+        chunk = -(-N // W)
+        v[::chunk] = 127.0  # pin each chunk's absmax (stage-1 AND stage-2)
+        vecs = np.broadcast_to(v, (W, N)).copy()
+        out = np.asarray(_run(jnp.asarray(vecs), jax.random.key(1)))
+        for w in range(W):
+            np.testing.assert_allclose(out[w], v, rtol=1e-6, atol=1e-6)
+
+    def test_unbiased(self):
+        """E[compressed mean] = true mean: average over many independent
+        keys converges (stochastic rounding is unbiased at both stages)."""
+        rng = np.random.default_rng(2)
+        vecs = jnp.asarray(rng.normal(size=(W, N)).astype(np.float32))
+        want = np.asarray(vecs).mean(axis=0)
+        trials = 200
+        acc = np.zeros(N, np.float64)
+        for t in range(trials):
+            out = np.asarray(_run(vecs, jax.random.key(t)))
+            acc += out[0]
+        est = acc / trials
+        scale = np.abs(np.asarray(vecs)).max() / 127.0
+        # Std of the estimator ~ scale/sqrt(trials); 5 sigma headroom.
+        tol = 5 * scale / np.sqrt(trials)
+        assert np.max(np.abs(est - want)) < tol, (
+            f"max bias {np.max(np.abs(est - want)):.5f} vs tol {tol:.5f}"
+        )
+
+    def test_wire_payload_is_int8(self):
+        """The compiled program's collective ops must carry s8 tensors —
+        the bandwidth claim, pinned at the HLO level."""
+        vecs = jnp.zeros((W, N), jnp.float32)
+        fn = shard_map(
+            lambda v, k: compressed_allreduce_mean(v[0], "data", W, k[0])[None],
+            mesh=_mesh(),
+            in_specs=(P("data"), P("data")),
+            out_specs=P("data"),
+            check_vma=False,
+        )
+        keys = jax.random.split(jax.random.key(0), W)
+        hlo = jax.jit(fn).lower(vecs, keys).compile().as_text()
+        collective_lines = [
+            l for l in hlo.splitlines()
+            if ("all-to-all" in l or "all-gather" in l)
+        ]
+        assert collective_lines, "no collectives found in HLO"
+        s8_lines = [l for l in collective_lines if "s8[" in l]
+        assert s8_lines, (
+            "no int8 collective in HLO:\n" + "\n".join(collective_lines)
+        )
+
+    def test_training_learns_with_int8_allreduce(self):
+        from mercury_tpu.config import TrainConfig
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+        from mercury_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(
+            model="smallcnn", dataset="synthetic", world_size=4, batch_size=8,
+            presample_batches=2, steps_per_epoch=60, num_epochs=1,
+            grad_compression="int8", eval_every=0, log_every=0,
+            compute_dtype="float32", seed=0,
+        )
+        tr = Trainer(cfg, mesh=host_cpu_mesh(4))
+        losses = []
+        for _ in range(60):
+            tr.state, m = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices)
+            losses.append(float(m["train/loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.8
+
+    def test_rejects_zero_sharding_combination(self):
+        import pytest
+
+        from mercury_tpu.config import TrainConfig
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+        from mercury_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(
+            model="smallcnn", dataset="synthetic", world_size=4,
+            grad_compression="int8", zero_sharding=True,
+            compute_dtype="float32",
+        )
+        with pytest.raises(ValueError, match="int8"):
+            Trainer(cfg, mesh=host_cpu_mesh(4))
