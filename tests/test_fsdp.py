@@ -1,0 +1,116 @@
+"""FSDP-style fully-sharded parameters (``parallel/fsdp.py``).
+
+Pinned: large leaves physically sharded 1/W per device, optimizer state
+inheriting the layout (ZeRO-2 for free), numerical equivalence of one
+step with replicated training, layout stability across steps, and
+end-to-end learning.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from mercury_tpu.models import TransformerClassifier
+from mercury_tpu.parallel.fsdp import (
+    fsdp_shardings,
+    make_fsdp_train_step,
+    shard_params_fsdp,
+)
+from mercury_tpu.sampling.importance import per_sample_loss
+
+W = 8
+KW = dict(num_classes=5, d_model=64, num_heads=4, num_layers=2, max_len=16)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:W]), ("data",))
+
+
+def _setup():
+    model = TransformerClassifier(**KW)
+    x = jax.random.normal(jax.random.key(0), (16, 16, 8), jnp.float32)
+    y = jnp.arange(16) % 5
+    params = model.init(jax.random.key(1), x, train=False)["params"]
+    return model, x, y, params
+
+
+class TestFsdp:
+    def test_large_leaves_physically_sharded(self):
+        _, _, _, params = _setup()
+        mesh = _mesh()
+        sharded = shard_params_fsdp(params, mesh)
+        n_sharded = 0
+        for leaf in jax.tree_util.tree_leaves(sharded):
+            if leaf.size >= 1024:
+                shapes = {s.data.shape for s in leaf.addressable_shards}
+                assert len(shapes) == 1
+                shard_shape = next(iter(shapes))
+                assert np.prod(shard_shape) * W == leaf.size, (
+                    f"leaf {leaf.shape} not 1/{W}-sharded: {shard_shape}"
+                )
+                n_sharded += 1
+        assert n_sharded >= 10  # every block kernel + embeddings
+
+    def test_one_step_matches_replicated(self):
+        model, x, y, params = _setup()
+        mesh = _mesh()
+        tx = optax.sgd(0.1)
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x, train=True)
+            return jnp.mean(per_sample_loss(logits, y))
+
+        ref_loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        p_ref = optax.apply_updates(params, updates)
+
+        sharded = shard_params_fsdp(params, mesh)
+        opt_state = tx.init(sharded)
+        step = make_fsdp_train_step(model, tx, mesh)
+        p2, _, loss = step(sharded, opt_state, x, y)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(p2),
+                        jax.tree_util.tree_leaves(p_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_optimizer_state_inherits_sharding(self):
+        """ZeRO-2 for free: adam moments placed like their params."""
+        _, _, _, params = _setup()
+        mesh = _mesh()
+        sharded = shard_params_fsdp(params, mesh)
+        opt_state = optax.adam(1e-3).init(sharded)
+        mu = opt_state[0].mu
+        for p_leaf, m_leaf in zip(jax.tree_util.tree_leaves(sharded),
+                                  jax.tree_util.tree_leaves(mu)):
+            assert p_leaf.sharding == m_leaf.sharding, (
+                p_leaf.sharding, m_leaf.sharding
+            )
+
+    def test_layout_stable_and_learns(self):
+        model, x, y, params = _setup()
+        mesh = _mesh()
+        tx = optax.adam(1e-3)
+        sharded = shard_params_fsdp(params, mesh)
+        want = jax.tree_util.tree_map(lambda l: l.sharding, sharded)
+        opt_state = tx.init(sharded)
+        step = make_fsdp_train_step(model, tx, mesh)
+        losses = []
+        for _ in range(20):
+            sharded, opt_state, loss = step(sharded, opt_state, x, y)
+            losses.append(float(loss))
+        got = jax.tree_util.tree_map(lambda l: l.sharding, sharded)
+        assert want == got, "param shardings drifted across steps"
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_small_leaves_replicated(self):
+        _, _, _, params = _setup()
+        specs = fsdp_shardings(params, _mesh())
+        # LayerNorm scales/biases are [64] < 1024 elements → replicated.
+        flat = {"/".join(str(getattr(p, "key", p)) for p in path): s
+                for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]}
+        ln = [s for name, s in flat.items() if "LayerNorm" in name]
+        assert ln and all(s.spec == () or s.spec == (None,) * len(s.spec)
+                          for s in ln)
