@@ -102,29 +102,26 @@ def make_fsdp_train_step(
     def shardings_of(tree):
         return jax.tree_util.tree_map(lambda x: x.sharding, tree)
 
-    # One jitted function per input layout (stable by construction after
-    # the first step, so in practice this compiles once and every later
-    # call is a dict hit + the C++ jit fastpath).
-    jit_cache = {}
+    # The FIRST call canonicalizes placements and fixes the layout (pinned
+    # thereafter by out_shardings + donation); later calls go straight to
+    # the jitted function — no per-step tree traversals, so the C++ jit
+    # fastpath is the actual per-step cost. Contract: feed back the
+    # returned params/opt_state (their layout matches by construction; a
+    # foreign layout raises a clear jit placement error).
+    cache = {}
 
     def jitted(params, opt_state, x, y):
-        params = jax.tree_util.tree_map(canon, params)
-        opt_state = jax.tree_util.tree_map(canon, opt_state)
-        key = (
-            tuple(l.sharding for l in jax.tree_util.tree_leaves(params)),
-            tuple(l.sharding for l in jax.tree_util.tree_leaves(opt_state)),
-        )
-        fn = jit_cache.get(key)
-        if fn is None:
-            fn = jax.jit(
+        if "fn" not in cache:
+            params = jax.tree_util.tree_map(canon, params)
+            opt_state = jax.tree_util.tree_map(canon, opt_state)
+            cache["fn"] = jax.jit(
                 step,
                 out_shardings=(shardings_of(params), shardings_of(opt_state),
                                replicated),
                 donate_argnums=(0, 1),
             )
-            jit_cache[key] = fn
         x = jax.device_put(x, batch_sharding)
         y = jax.device_put(y, batch_sharding)
-        return fn(params, opt_state, x, y)
+        return cache["fn"](params, opt_state, x, y)
 
     return jitted
