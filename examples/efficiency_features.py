@@ -1,6 +1,6 @@
 """The efficiency ladder on one model: memory and bandwidth features.
 
-Runs the same small training job five ways and reports loss + what each
+Runs the same small training job six ways and reports loss + what each
 feature changes:
 
 1. baseline           — replicated params, f32 allreduce
@@ -29,6 +29,12 @@ from mercury_tpu.train.trainer import Trainer
 STEPS = 40
 
 
+def elems_per_device(tree) -> int:
+    """Device-0's physical shard elements summed over a pytree."""
+    return sum(s.data.size for leaf in jax.tree_util.tree_leaves(tree)
+               for s in leaf.addressable_shards[:1])
+
+
 def run(label, **kw):
     base = dict(
         model="smallcnn", dataset="synthetic", world_size=len(jax.devices()),
@@ -45,15 +51,9 @@ def run(label, **kw):
                                     tr.dataset.y_train,
                                     tr.dataset.shard_indices)
         loss = float(m["train/loss"])
-    # Optimizer-state elements on ONE device (the ZeRO savings, visible):
-    # device-0's physical shard of every leaf.
-    opt_per_dev = sum(
-        s.data.size
-        for leaf in jax.tree_util.tree_leaves(tr.state.opt_state)
-        for s in leaf.addressable_shards[:1]
-    )
+    # Optimizer-state elements on ONE device (the ZeRO savings, visible).
     print(f"{label:28s} final loss {loss:.4f}   opt-state elems/device "
-          f"{opt_per_dev:>9,}")
+          f"{elems_per_device(tr.state.opt_state):>9,}")
 
 
 def run_fsdp():
@@ -78,8 +78,7 @@ def run_fsdp():
     loss = None
     for _ in range(STEPS):
         params, opt, loss = step(params, opt, x, y)
-    per_dev = sum(s.data.size for l in jax.tree_util.tree_leaves(params)
-                  for s in l.addressable_shards[:1])
+    per_dev = elems_per_device(params)
     total = sum(l.size for l in jax.tree_util.tree_leaves(params))
     print(f"{'fsdp (transformer)':28s} final loss {float(loss):.4f}   "
           f"param elems/device {per_dev:,} of {total:,} "

@@ -106,8 +106,10 @@ def make_fsdp_train_step(
     # thereafter by out_shardings + donation); later calls go straight to
     # the jitted function — no per-step tree traversals, so the C++ jit
     # fastpath is the actual per-step cost. Contract: feed back the
-    # returned params/opt_state (their layout matches by construction; a
-    # foreign layout raises a clear jit placement error).
+    # returned params/opt_state. A foreign layout is NOT an error — jit
+    # recompiles and reshards to the pinned out_shardings each step (with
+    # unusable-donation warnings), so keep the returned trees to avoid
+    # that hidden per-step reshard.
     cache = {}
 
     def jitted(params, opt_state, x, y):
