@@ -72,6 +72,13 @@ class TrainConfig:
     presample_batches: int = 10      # candidate pool = 10×batch (pytorch_collab.py:95)
     is_alpha: float = 0.5            # score = loss + alpha·EMA (pytorch_collab.py:111)
     ema_alpha: float = 0.9           # EMA smoothing factor (util.py:202)
+    # What the candidate scorer computes from the pool logits:
+    # - "loss": per-sample CE (the reference's score, pytorch_collab.py:102)
+    # - "grad_norm": ||softmax − onehot||₂ — the exact CE-gradient norm
+    #   w.r.t. the logits, the variance-optimal upper-bound score of
+    #   Katharopoulos & Fleuret (arXiv:1803.00942). Same cost; the
+    #   reweighting stays unbiased for any score.
+    importance_score: str = "loss"
     sync_importance_stats: bool = True  # north-star: psum (sum_loss, count) across workers
     # Pipelined scoring (pool sampler only): step t trains on the batch
     # selected at step t-1 and scores the NEXT pool with the same params —

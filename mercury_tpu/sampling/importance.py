@@ -71,6 +71,35 @@ def per_sample_loss(
     return nll
 
 
+def per_sample_grad_norm_bound(
+    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
+) -> jax.Array:
+    """Per-sample gradient-norm importance score: ``||softmax(z_i) −
+    target(y_i)||₂``.
+
+    This is the exact L2 norm of the (optionally label-smoothed)
+    cross-entropy gradient w.r.t. the logits — the target matching the
+    training objective: ``(1−ls)·onehot + ls/K`` — which upper-bounds (up
+    to the network's Lipschitz factor) the full per-sample
+    parameter-gradient norm: the variance-optimal importance score of
+    Katharopoulos & Fleuret, *"Not All Samples Are Created Equal: Deep
+    Learning with Importance Sampling"* (arXiv:1803.00942; retrieved in
+    PAPERS.md). Computable from the scoring forward's logits at no extra
+    cost, in place of the loss score the reference uses
+    (``pytorch_collab.py:102``) — select with
+    ``config.importance_score="grad_norm"``. The downstream IS math
+    (smoothing, normalization, ``1/(N·p)`` reweighting) is score-agnostic,
+    so the estimator stays unbiased for any score.
+    """
+    logits = logits.astype(jnp.float32)
+    k = logits.shape[-1]
+    p = jax.nn.softmax(logits, axis=-1)
+    target = jax.nn.one_hot(labels, k, dtype=jnp.float32)
+    if label_smoothing > 0.0:
+        target = (1.0 - label_smoothing) * target + label_smoothing / k
+    return jnp.linalg.norm(p - target, axis=-1)
+
+
 def importance_probs(
     losses: jax.Array, ema_value: jax.Array, alpha: float = 0.5
 ) -> jax.Array:

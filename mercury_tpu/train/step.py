@@ -35,6 +35,7 @@ from mercury_tpu.parallel.collectives import allreduce_mean_tree
 from mercury_tpu.sampling.importance import (
     EMAState,
     ema_update,
+    per_sample_grad_norm_bound,
     per_sample_loss,
     pool_mean,
     reweighted_loss,
@@ -117,12 +118,27 @@ def make_train_step(
     if pipelined and use_groupwise:
         raise ValueError("pipelined_scoring requires sampler='pool'")
 
+    if config.importance_score not in ("loss", "grad_norm"):
+        raise ValueError(
+            f"unknown importance_score {config.importance_score!r}"
+        )
+
     def _loss_per_sample(logits, labels):
         if use_pallas:
             from mercury_tpu.ops import per_sample_nll_pallas
 
             return per_sample_nll_pallas(logits, labels)
         return per_sample_loss(logits, labels, config.label_smoothing)
+
+    def _score_per_sample(logits, labels):
+        """Candidate scorer: what the pool forward's logits become scores
+        by. Training losses always use ``_loss_per_sample`` — the IS
+        reweighting is score-agnostic, so any scorer stays unbiased."""
+        if config.importance_score == "grad_norm":
+            return per_sample_grad_norm_bound(
+                logits, labels, config.label_smoothing
+            )
+        return _loss_per_sample(logits, labels)
 
     def _apply_train(params, batch_stats, images, keep_stats: bool):
         """Train-mode forward. ``keep_stats=False`` (the scoring pass) uses
@@ -212,7 +228,7 @@ def make_train_step(
                 pool_logits, _, _ = _apply_train(
                     state.params, state.batch_stats, imgs, False
                 )
-                pool_losses = _loss_per_sample(pool_logits, labs)
+                pool_losses = _score_per_sample(pool_logits, labs)
                 selected, scaled, ema, avg = _select(ksel, pool_losses, ema)
                 pend = PendingBatch(
                     images=imgs[selected], labels=labs[selected],
@@ -267,7 +283,7 @@ def make_train_step(
                 pool_logits, _, _ = _apply_train(
                     state.params, state.batch_stats, images, False
                 )
-                pool_losses = _loss_per_sample(pool_logits, labels)
+                pool_losses = _score_per_sample(pool_logits, labels)
                 if use_groupwise:
                     # Persist scores into the shard-wide importance array,
                     # tag the new generation, draw from it with the +mean
