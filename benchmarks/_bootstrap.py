@@ -1,0 +1,22 @@
+"""Shared benchmark bootstrap: import this first in every benchmark script.
+
+Same contract as ``examples/_bootstrap.py``: makes the repo root importable
+without installing the package, and honors a virtual-CPU request — this
+image's sitecustomize re-pins ``JAX_PLATFORMS`` to the tunneled-TPU backend
+at interpreter start (and hangs when that tunnel is down), so the surviving
+``xla_force_host_platform_device_count`` flag is treated as the CPU signal
+(the ``tests/conftest.py`` dance).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+)
+
+if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

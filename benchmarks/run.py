@@ -25,7 +25,7 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
 
 from mercury_tpu.config import TrainConfig  # noqa: E402
 

@@ -22,14 +22,14 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
 
 import numpy as np  # noqa: E402
 
 from mercury_tpu.config import TrainConfig  # noqa: E402
 
 
-def run_arm(use_is: bool, args) -> dict:
+def run_arm(label: str, args, **overrides) -> dict:
     import jax
 
     from mercury_tpu.parallel.mesh import make_mesh
@@ -43,13 +43,13 @@ def run_arm(use_is: bool, args) -> dict:
         world_size=world,
         batch_size=args.batch_size,
         presample_batches=args.presample_batches,
-        use_importance_sampling=use_is,
         steps_per_epoch=args.steps,
         num_epochs=1,
         eval_every=0,
         log_every=0,
         compute_dtype=args.compute_dtype,
         seed=args.seed,
+        **overrides,
     )
     trainer = Trainer(config, mesh=make_mesh(world, config.mesh_axis))
     ds = trainer.dataset
@@ -63,9 +63,8 @@ def run_arm(use_is: bool, args) -> dict:
         np.asarray(m["train/loss"])
         acc = trainer.evaluate(include_train=False)["test/eval_acc"]
         trajectory.append({"step": step, "test_acc": round(float(acc), 4)})
-        print(f"# {'is' if use_is else 'uniform'} step {step} acc {acc:.4f}",
-              file=sys.stderr)
-    return {"use_is": use_is, "trajectory": trajectory}
+        print(f"# {label} step {step} acc {acc:.4f}", file=sys.stderr)
+    return {"label": label, "trajectory": trajectory}
 
 
 def steps_to(trajectory, target):
@@ -91,7 +90,13 @@ def main(argv=None) -> int:
         os.path.dirname(__file__), "results_sample_efficiency.jsonl"))
     args = ap.parse_args(argv)
 
-    arms = [run_arm(True, args), run_arm(False, args)]
+    # Three arms: the reference's loss score, the Katharopoulos-Fleuret
+    # gradient-norm score, and the uniform control.
+    arms = [
+        run_arm("is_loss", args),
+        run_arm("is_grad_norm", args, importance_score="grad_norm"),
+        run_arm("uniform", args, use_importance_sampling=False),
+    ]
     record = {
         "model": args.model,
         "dataset": args.dataset,
@@ -99,10 +104,18 @@ def main(argv=None) -> int:
         "batch_size": args.batch_size,
         "steps": args.steps,
         "target_acc": args.target_acc,
+        "arms": {
+            a["label"]: {
+                "trajectory": a["trajectory"],
+                "steps_to_target": steps_to(a["trajectory"], args.target_acc),
+            }
+            for a in arms
+        },
+        # Back-compat aliases for the original two-arm schema.
         "is_trajectory": arms[0]["trajectory"],
-        "uniform_trajectory": arms[1]["trajectory"],
+        "uniform_trajectory": arms[2]["trajectory"],
         "is_steps_to_target": steps_to(arms[0]["trajectory"], args.target_acc),
-        "uniform_steps_to_target": steps_to(arms[1]["trajectory"], args.target_acc),
+        "uniform_steps_to_target": steps_to(arms[2]["trajectory"], args.target_acc),
     }
     with open(args.out, "a") as f:
         f.write(json.dumps(record) + "\n")

@@ -140,6 +140,15 @@ def make_train_step(
             )
         return _loss_per_sample(logits, labels)
 
+    def _pool_loss_metric(pool_logits, labels, score_avg):
+        """Keep the ``train/pool_loss`` metric a true mean CE even when the
+        SCORES are gradient norms (the EMA still smooths the score
+        statistic — that's the selection math); comparing pool-loss curves
+        across score modes must compare the same quantity."""
+        if config.importance_score == "grad_norm":
+            return pool_mean(_loss_per_sample(pool_logits, labels), stat_axis)
+        return score_avg
+
     def _apply_train(params, batch_stats, images, keep_stats: bool):
         """Train-mode forward. ``keep_stats=False`` (the scoring pass) uses
         batch statistics for normalization but discards the running-stat
@@ -234,7 +243,9 @@ def make_train_step(
                     images=imgs[selected], labels=labs[selected],
                     scaled_probs=scaled,
                 )
-                return stream, ema, pend, avg
+                return stream, ema, pend, _pool_loss_metric(
+                    pool_logits, labs, avg
+                )
 
             stored = jax.tree_util.tree_map(lambda x: x[0], state.pending)
 
@@ -297,11 +308,17 @@ def make_train_step(
                         k_aug2, normalize_images(x_train[sel_global], mean, std)
                     )
                     sel_labels = y_train[sel_global]
-                    avg_pool_loss = pool_mean(pool_losses, stat_axis)
-                    ema = ema_update(ema, avg_pool_loss, config.ema_alpha)
+                    score_avg = pool_mean(pool_losses, stat_axis)
+                    ema = ema_update(ema, score_avg, config.ema_alpha)
+                    avg_pool_loss = _pool_loss_metric(
+                        pool_logits, labels, score_avg
+                    )
                 else:
-                    selected, scaled_probs, ema, avg_pool_loss = _select(
+                    selected, scaled_probs, ema, score_avg = _select(
                         k_sel, pool_losses, ema
+                    )
+                    avg_pool_loss = _pool_loss_metric(
+                        pool_logits, labels, score_avg
                     )
                     sel_images = images[selected]
                     sel_labels = labels[selected]
