@@ -359,6 +359,50 @@ class Trainer:
         out.update(self._eval_split(train=False))
         return out
 
+    # ------------------------------------------------------------- inference
+    def predict(self, inputs) -> np.ndarray:
+        """Inference-mode logits for raw inputs.
+
+        ``inputs``: ``[N, H, W, C]`` images (uint8 or float — normalized
+        with the dataset's statistics, as eval does) or ``[N, T, F]``
+        sequences (passed through). Returns ``[N, num_classes]`` float32
+        logits; ``argmax(-1)`` gives class predictions. The reference has
+        no inference entry point at all — evaluation is the closest thing
+        (``pytorch_collab.py:201-234``).
+        """
+        # Multi-controller: keep inputs host-resident (replicated by jit)
+        # so they compose with the global params — same guard as
+        # _eval_arrays.
+        x = np.asarray(inputs)
+        if x.ndim == len(self.dataset.x_train.shape[1:]):
+            x = x[None]  # single sample convenience
+        if not hasattr(self, "_predict_fn"):
+            model = self.model
+            mean, std = self.dataset.mean, self.dataset.std
+            iid_eval = self.config.augmentation == "iid"
+
+            def fwd(params, batch_stats, x):
+                from mercury_tpu.data.pipeline import normalize_images
+
+                # The exact eval-path preprocessing (make_eval_epoch):
+                # normalize (no-op stats for sequences), and the IID
+                # path's fixed-key eval transform.
+                x = normalize_images(x, mean, std)
+                if iid_eval:
+                    from mercury_tpu.data.transforms import eval_transform_iid
+
+                    x = eval_transform_iid(jax.random.key(0), x)
+                variables = {"params": params}
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                return model.apply(variables, x, train=False)
+
+            self._predict_fn = jax.jit(fwd)
+        return np.asarray(
+            self._predict_fn(self.state.params, self.state.batch_stats, x),
+            np.float32,
+        )
+
     # ----------------------------------------------------- checkpoint hooks
     def save(self, directory: Optional[str] = None) -> str:
         directory = directory or self.config.checkpoint_dir

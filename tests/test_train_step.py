@@ -339,3 +339,35 @@ class TestEval:
             assert k in out
             assert np.isfinite(out[k])
         assert 0.0 <= out["test/eval_acc"] <= 1.0
+
+
+class TestPredict:
+    """``Trainer.predict`` — inference logits for raw inputs."""
+
+    def test_predict_matches_eval_accuracy(self):
+        from mercury_tpu.config import TrainConfig
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+        from mercury_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(
+            model="smallcnn", dataset="synthetic", world_size=4, batch_size=8,
+            presample_batches=2, steps_per_epoch=30, num_epochs=1,
+            eval_every=0, log_every=0, compute_dtype="float32", seed=0,
+        )
+        tr = Trainer(cfg, mesh=host_cpu_mesh(4))
+        for _ in range(30):
+            tr.state, _ = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices)
+        n = 256
+        logits = tr.predict(np.asarray(tr.dataset.x_test)[:n])
+        assert logits.shape == (n, tr.dataset.num_classes)
+        assert logits.dtype == np.float32
+        acc = float(np.mean(
+            np.argmax(logits, -1) == np.asarray(tr.dataset.y_test)[:n]))
+        # Same quantity the eval path computes on this slice.
+        want = tr._eval_split(train=False)["test/eval_acc"]
+        assert abs(acc - want) < 0.15  # slice vs full split, same regime
+        # Single-sample convenience: adds the batch dim.
+        one = tr.predict(np.asarray(tr.dataset.x_test)[0])
+        assert one.shape == (1, tr.dataset.num_classes)
