@@ -493,7 +493,8 @@ def make_eval_step(model) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array
 
 
 def make_eval_epoch(
-    model, mean: np.ndarray, std: np.ndarray, eval_augmentation: str = "none"
+    model, mean: np.ndarray, std: np.ndarray, eval_augmentation: str = "none",
+    mesh: Optional[Mesh] = None, axis: str = "data",
 ) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array]]:
     """One-dispatch full-split eval: ``lax.scan`` over pre-batched uint8
     arrays, normalize + forward + masked reduce in-graph.
@@ -502,6 +503,11 @@ def make_eval_epoch(
     host (``pytorch_collab.py:201-234``); a whole split here is a single
     device call — this matters when dispatch latency is non-trivial (e.g. a
     tunneled chip: ~24 host round trips become 1).
+
+    With ``mesh``, each scanned batch's sample dimension is sharded over
+    the mesh's data axis (``in_shardings`` only — GSPMD partitions the
+    forward and inserts the reduction collectives), so eval uses every
+    device instead of leaving W−1 idle.
 
     ``eval_augmentation="iid"`` applies the reference IID path's *test*
     transform — resize(33) → random crop(32) (``exp_dataset.py:63-68``; yes,
@@ -544,4 +550,16 @@ def make_eval_epoch(
         )
         return loss_sum, correct, count
 
-    return jax.jit(eval_epoch)
+    if mesh is None:
+        return jax.jit(eval_epoch)
+    from jax.sharding import NamedSharding
+
+    from mercury_tpu.parallel.mesh import replicated_sharding
+
+    rep = replicated_sharding(mesh)
+    batched = NamedSharding(mesh, P(None, axis))  # [nb, B, ...]: shard B
+    return jax.jit(
+        eval_epoch,
+        in_shardings=(rep, rep, batched, batched, batched),
+        out_shardings=(rep, rep, rep),
+    )

@@ -209,14 +209,22 @@ class Trainer:
             else None
         )
         self.eval_step = make_eval_step(self.model)
+        # Shard eval batches over the mesh so evaluation uses every device
+        # (single-controller only: multi-process would need global eval
+        # arrays; there the replicated path is correct, just redundant).
+        eval_mesh = self.mesh if jax.process_count() == 1 else None
         self.eval_epoch = make_eval_epoch(self.model, self.dataset.mean,
                                           self.dataset.std,
                                           eval_augmentation=config.augmentation
                                           if config.augmentation == "iid"
-                                          else "none")
+                                          else "none",
+                                          mesh=eval_mesh,
+                                          axis=config.mesh_axis)
         self.logger = MetricsLogger(config.log_dir)
         self.history: List[Dict[str, float]] = []
-        self._eval_batch = 256
+        # Round up to a multiple of world_size so the sharded-eval batch
+        # dimension always divides the mesh axis (e.g. world_size=5 → 260).
+        self._eval_batch = -(-256 // config.world_size) * config.world_size
         self._eval_cache: Dict[bool, tuple] = {}
 
         # Crash/preemption recovery: pick up the newest checkpoint, sampler

@@ -371,3 +371,35 @@ class TestPredict:
         # Single-sample convenience: adds the batch dim.
         one = tr.predict(np.asarray(tr.dataset.x_test)[0])
         assert one.shape == (1, tr.dataset.num_classes)
+
+
+class TestShardedEval:
+    def test_sharded_eval_matches_unsharded(self):
+        """make_eval_epoch(mesh=...) shards each batch over the data axis;
+        the sums must equal the single-device path exactly."""
+        from mercury_tpu.models import create_model
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+        from mercury_tpu.train.step import make_eval_epoch
+
+        model = create_model("smallcnn", num_classes=10,
+                             compute_dtype="float32")
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 256, (4, 64, 32, 32, 3)).astype(np.uint8)
+        labels = rng.integers(0, 10, (4, 64)).astype(np.int32)
+        valid = np.ones((4, 64), bool)
+        valid[-1, 40:] = False
+        mean = np.zeros(3, np.float32)
+        std = np.ones(3, np.float32)
+        variables = model.init(jax.random.key(0),
+                               np.zeros((1, 32, 32, 3), np.float32),
+                               train=False)
+        params = variables["params"]
+        bs = variables.get("batch_stats", {})
+
+        plain = make_eval_epoch(model, mean, std)
+        sharded = make_eval_epoch(model, mean, std, mesh=host_cpu_mesh(8))
+        a = plain(params, bs, images, labels, valid)
+        b = sharded(params, bs, images, labels, valid)
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5, atol=1e-4)
