@@ -129,6 +129,10 @@ class TrainConfig:
     log_dir: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000     # steps; 0 disables
+    # Write cadence checkpoints on a background thread (the device→host
+    # fetch stays synchronous; serialization/IO overlap training).
+    # Single-process only; multi-controller saves stay synchronous.
+    async_checkpoint: bool = False
     # Restore the latest checkpoint in checkpoint_dir (if any) at Trainer
     # construction — crash/preemption recovery without a separate restore
     # call. The sampler state is in the checkpoint, so the resumed

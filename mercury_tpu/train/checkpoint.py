@@ -138,6 +138,55 @@ def _write_msgpack(path: str, to_save: Any) -> None:
         f.write(flax.serialization.to_bytes(to_save))
 
 
+class _AsyncSave:
+    """Handle for an in-flight background checkpoint write. ``join()``
+    blocks until the write completes and RE-RAISES any exception the
+    writer thread hit (a silently missing cadence checkpoint would
+    otherwise surface only as a much older restore after a preemption)."""
+
+    def __init__(self, target, name: str):
+        import threading
+
+        self._exc: Optional[BaseException] = None
+
+        def runner():
+            try:
+                target()
+            except BaseException as e:  # re-raised at join
+                self._exc = e
+
+        self._thread = threading.Thread(target=runner, name=name,
+                                        daemon=False)
+        self._thread.start()
+
+    def join(self) -> None:
+        self._thread.join()
+        if self._exc is not None:
+            raise self._exc
+
+
+def save_checkpoint_async(directory: str, state: Any, step: int):
+    """Non-blocking save: the device→host fetch happens synchronously (it
+    must — the caller's next train step donates/overwrites the state
+    buffers), then serialization + file IO run on a background thread so
+    training resumes immediately. Returns an :class:`_AsyncSave` handle —
+    ``join()`` it before reading the file or exiting; writer-thread
+    failures re-raise there.
+
+    Single-process only: multi-controller saves need their cross-process
+    barrier to stay on the caller's thread (collective ordering), so this
+    falls back to the synchronous path there (returning ``None``).
+    """
+    if jax.process_count() > 1:
+        save_checkpoint(directory, state, step)
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = _ckpt_path(directory, step)
+    to_save = _host_gather(_unwrap_keys(state))
+    return _AsyncSave(lambda: _write_msgpack(path, to_save),
+                      name=f"ckpt-write-{step}")
+
+
 def latest_step(directory: str) -> Optional[int]:
     """Newest checkpoint step in ``directory``, or None."""
     if not os.path.isdir(directory):

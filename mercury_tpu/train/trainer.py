@@ -226,6 +226,7 @@ class Trainer:
         # dimension always divides the mesh axis (e.g. world_size=5 → 260).
         self._eval_batch = -(-256 // config.world_size) * config.world_size
         self._eval_cache: Dict[bool, tuple] = {}
+        self._ckpt_thread = None  # in-flight async checkpoint write
 
         # Crash/preemption recovery: pick up the newest checkpoint, sampler
         # state included (bit-deterministic IS resume). The NEXT fit() then
@@ -271,7 +272,8 @@ class Trainer:
             """Did [at-advanced, at] cross a multiple of ``every``?"""
             return bool(every) and (at // every) > ((at - advanced) // every)
 
-        while step < end:
+        try:
+          while step < end:
             if self.train_step_many is not None and step + self.scan_steps <= end:
                 k = self.scan_steps
                 self.state, metrics = self.train_step_many(
@@ -315,7 +317,23 @@ class Trainer:
                     + " ".join(f"{k}={v:.4f}" for k, v in final_metrics.items())
                 )
             if cfg.checkpoint_dir and crossed(cfg.checkpoint_every, step, k):
-                ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
+                if cfg.async_checkpoint:
+                    # One in-flight write at a time: join the previous
+                    # before fetching the next snapshot.
+                    if self._ckpt_thread is not None:
+                        self._ckpt_thread.join()
+                    self._ckpt_thread = ckpt.save_checkpoint_async(
+                        cfg.checkpoint_dir, self.state, step
+                    )
+                else:
+                    ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
+        finally:
+            # An exception mid-loop (KeyboardInterrupt, eval error) must not
+            # leave a write in flight — a relaunched auto_resume reading a
+            # half-written file would restore garbage.
+            if self._ckpt_thread is not None:
+                self._ckpt_thread.join()
+                self._ckpt_thread = None
         if not final_metrics:
             final_metrics = self.evaluate()
         if cfg.checkpoint_dir:
