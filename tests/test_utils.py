@@ -87,3 +87,33 @@ class TestQuantize:
 
     def test_sparsity(self):
         assert float(sparsity(jnp.asarray([0.0, 1.0, 0.0, 2.0]))) == pytest.approx(0.5)
+
+
+class TestMetricsLogger:
+    """JSONL scalar logging (≡ the reference's rank-0 TensorBoardX tags,
+    pytorch_collab.py:187-190)."""
+
+    def test_jsonl_records(self, tmp_path):
+        import json
+
+        from mercury_tpu.utils.logging import MetricsLogger
+
+        logger = MetricsLogger(str(tmp_path))
+        logger.log_scalars(100, {"train/acc": 0.5, "train/loss": 1.25})
+        logger.log_scalars(200, {"test/acc": 0.25})
+        logger.close()
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "metrics.jsonl").read().splitlines()]
+        assert [l["step"] for l in lines] == [100, 200]
+        assert lines[0]["train/acc"] == 0.5
+        assert lines[0]["train/loss"] == 1.25
+        assert lines[1]["test/acc"] == 0.25
+        assert all("time" in l for l in lines)
+
+    def test_disabled_without_log_dir(self):
+        from mercury_tpu.utils.logging import MetricsLogger
+
+        logger = MetricsLogger(None)
+        logger.log_scalars(1, {"train/acc": 1.0})  # must be a no-op
+        logger.close()
+        assert not logger.enabled
