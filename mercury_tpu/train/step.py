@@ -492,6 +492,58 @@ def make_eval_step(model) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array
     return jax.jit(eval_fn)
 
 
+def make_per_class_epoch(
+    model, mean: np.ndarray, std: np.ndarray, num_classes: int,
+    eval_augmentation: str = "none",
+    mesh: Optional[Mesh] = None, axis: str = "data",
+) -> Callable[..., Tuple[jax.Array, jax.Array]]:
+    """One-dispatch per-class (hits, totals) over pre-batched eval arrays —
+    same scan/sharding structure as :func:`make_eval_epoch`, with a
+    scatter-add per batch instead of scalar sums. Returns int32 ``[C]``
+    pairs for host-side division."""
+    from mercury_tpu.data.pipeline import normalize_images
+
+    def per_class_epoch(params, batch_stats, images_b, labels_b, valid_b):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+
+        def body(carry, batch):
+            imgs_u8, labels, mask = batch
+            imgs = normalize_images(imgs_u8, mean, std)
+            if eval_augmentation == "iid":
+                from mercury_tpu.data.transforms import eval_transform_iid
+
+                imgs = eval_transform_iid(jax.random.key(0), imgs)
+            logits = model.apply(variables, imgs, train=False)
+            maski = mask.astype(jnp.int32)
+            hit = (jnp.argmax(logits, -1) == labels).astype(jnp.int32) * maski
+            hits, totals = carry
+            return (hits.at[labels].add(hit),
+                    totals.at[labels].add(maski)), None
+
+        init = (jnp.zeros((num_classes,), jnp.int32),
+                jnp.zeros((num_classes,), jnp.int32))
+        (hits, totals), _ = jax.lax.scan(
+            body, init, (images_b, labels_b, valid_b)
+        )
+        return hits, totals
+
+    if mesh is None:
+        return jax.jit(per_class_epoch)
+    from jax.sharding import NamedSharding
+
+    from mercury_tpu.parallel.mesh import replicated_sharding
+
+    rep = replicated_sharding(mesh)
+    batched = NamedSharding(mesh, P(None, axis))
+    return jax.jit(
+        per_class_epoch,
+        in_shardings=(rep, rep, batched, batched, batched),
+        out_shardings=(rep, rep),
+    )
+
+
 def make_eval_epoch(
     model, mean: np.ndarray, std: np.ndarray, eval_augmentation: str = "none",
     mesh: Optional[Mesh] = None, axis: str = "data",

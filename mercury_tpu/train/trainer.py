@@ -429,6 +429,34 @@ class Trainer:
             np.float32,
         )
 
+    def per_class_accuracy(self, train: bool = False) -> np.ndarray:
+        """Per-class accuracy over a split — the class-level view the
+        reference's scalar metrics can't give (relevant under Dirichlet
+        non-IID skew, where aggregate accuracy hides starved classes).
+        One scanned device dispatch over the cached eval batches (same
+        sharding as ``evaluate``). Returns ``[num_classes]`` float64;
+        classes absent from the split are NaN."""
+        if not hasattr(self, "_per_class_fn"):
+            from mercury_tpu.train.step import make_per_class_epoch
+
+            self._per_class_fn = make_per_class_epoch(
+                self.model, self.dataset.mean, self.dataset.std,
+                self.dataset.num_classes,
+                eval_augmentation=self.config.augmentation
+                if self.config.augmentation == "iid" else "none",
+                mesh=self.mesh if jax.process_count() == 1 else None,
+                axis=self.config.mesh_axis,
+            )
+        images_b, labels_b, valid_b = self._eval_arrays(train)
+        hits, totals = self._per_class_fn(
+            self.state.params, self.state.batch_stats,
+            images_b, labels_b, valid_b,
+        )
+        hits = np.asarray(hits, np.int64)
+        totals = np.asarray(totals, np.int64)
+        with np.errstate(invalid="ignore"):
+            return np.where(totals > 0, hits / np.maximum(totals, 1), np.nan)
+
     # ----------------------------------------------------- checkpoint hooks
     def save(self, directory: Optional[str] = None) -> str:
         directory = directory or self.config.checkpoint_dir

@@ -403,3 +403,30 @@ class TestShardedEval:
         for x, y in zip(a, b):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                        rtol=1e-5, atol=1e-4)
+
+
+class TestPerClassAccuracy:
+    def test_per_class_matches_aggregate(self):
+        from mercury_tpu.config import TrainConfig
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+        from mercury_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(
+            model="smallcnn", dataset="synthetic", world_size=4, batch_size=8,
+            presample_batches=2, steps_per_epoch=20, num_epochs=1,
+            eval_every=0, log_every=0, compute_dtype="float32", seed=0,
+        )
+        tr = Trainer(cfg, mesh=host_cpu_mesh(4))
+        for _ in range(20):
+            tr.state, _ = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices)
+        per_class = tr.per_class_accuracy(train=False)
+        assert per_class.shape == (tr.dataset.num_classes,)
+        y = np.asarray(tr.dataset.y_test)
+        counts = np.bincount(y, minlength=tr.dataset.num_classes)
+        # Class-weighted mean of per-class accuracy == aggregate accuracy.
+        valid = counts > 0
+        agg = float(np.nansum(per_class[valid] * counts[valid]) / counts.sum())
+        want = tr._eval_split(train=False)["test/eval_acc"]
+        np.testing.assert_allclose(agg, want, atol=1e-6)
