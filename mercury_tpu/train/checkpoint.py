@@ -131,11 +131,49 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
     return path
 
 
+def _sweep_stale_tmps(directory: str) -> None:
+    """Unlink ``.msgpack.tmp`` strays left by a crash mid-write. Called
+    only from the restore path (startup — before any write of this run
+    can be in flight, so no async writer's temp can be racing; sweeping
+    on save would race an unjoined previous ``save_checkpoint_async``).
+    Without it, each preempted run leaks a checkpoint-sized orphan into
+    the (possibly shared) directory."""
+    if jax.process_index() != 0:
+        return
+    try:
+        for name in os.listdir(directory):
+            if name.endswith(".msgpack.tmp"):
+                try:
+                    os.unlink(os.path.join(directory, name))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
 def _write_msgpack(path: str, to_save: Any) -> None:
+    """Atomic write: serialize to a temp file, then ``os.replace`` into
+    place. A hard crash (SIGKILL/preemption — the exact scenario
+    ``auto_resume`` targets) mid-write therefore leaves only a stray
+    ``.tmp``, never a truncated ``ckpt_<step>.msgpack`` that
+    :func:`latest_step` would pick as newest."""
     import flax.serialization
 
-    with open(path + ".msgpack", "wb") as f:
+    final = path + ".msgpack"
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(flax.serialization.to_bytes(to_save))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    # fsync the directory too: the rename itself is metadata, and on a
+    # journaled filesystem a crash right after os.replace can otherwise
+    # lose the directory entry for the new name.
+    dir_fd = os.open(os.path.dirname(final) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
 
 
 class _AsyncSave:
@@ -187,26 +225,103 @@ def save_checkpoint_async(directory: str, state: Any, step: int):
                       name=f"ckpt-write-{step}")
 
 
-def latest_step(directory: str) -> Optional[int]:
-    """Newest checkpoint step in ``directory``, or None."""
+def all_steps(directory: str) -> list:
+    """All checkpoint steps in ``directory``, ascending."""
     if not os.path.isdir(directory):
-        return None
-    steps = []
+        return []
+    steps = set()
     for name in os.listdir(directory):
         m = re.fullmatch(r"ckpt_(\d+)(\.msgpack)?", name)
         if m:
-            steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+            steps.add(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest checkpoint step in ``directory``, or None."""
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
 
 
 def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None) -> Tuple[Any, int]:
     """Restore the checkpoint at ``step`` (default: latest) into the
     structure of ``template`` (a live state used for pytree/shape/dtype
-    reference). Returns ``(state, step)``."""
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
+    reference). Returns ``(state, step)``.
+
+    When ``step`` is None (the ``auto_resume`` path), a checkpoint that
+    fails to deserialize — e.g. truncated by a crash predating atomic
+    writes, or torn on a non-atomic filesystem — is skipped with a
+    warning and the next-older step is tried, so one corrupt file does
+    not defeat crash recovery. An explicit ``step`` never falls back.
+
+    Multi-controller: every process walks the same candidate list and the
+    per-candidate success/failure is agreed GLOBALLY (all-gather of the
+    local outcome) — a transient read error on one host must not leave it
+    resuming an older step than its peers, which would silently mix
+    divergent states through the next gradient psum."""
+    if step is not None:
+        return _restore_one(directory, template, step), step
+    _sweep_stale_tmps(directory)
+    steps = all_steps(directory)
+    multi = jax.process_count() > 1
+    if multi:
+        # Agree on the candidate list itself: each process's os.listdir of
+        # a shared directory can disagree (NFS attribute-cache lag), and a
+        # divergent list would desynchronize the per-candidate allgather
+        # below — pairing one host's verdict for step 5 with another's for
+        # step 4. Walk process 0's list everywhere; a host whose listing
+        # is stale simply fails _restore_one and the group falls back
+        # together.
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        padded = np.full(256, -1, dtype=np.int32)
+        mine = np.asarray(steps[-256:], dtype=np.int32)
+        padded[: len(mine)] = mine
+        agreed = multihost_utils.broadcast_one_to_all(padded)
+        steps = [int(s) for s in agreed if s >= 0]
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {directory}")
+
+    def globally_ok(local_ok: bool) -> bool:
+        if not multi:
+            return local_ok
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([1.0 if local_ok else 0.0])
+        )
+        return bool(np.min(flags) > 0.5)
+
+    errors = []
+    for candidate in reversed(steps):
+        try:
+            restored = _restore_one(directory, template, candidate)
+            local_ok, err = True, None
+        except Exception as e:  # corrupt/partial file — try older
+            restored, local_ok, err = None, False, e
+        if globally_ok(local_ok):
+            return restored, candidate
+        if err is not None:
+            errors.append((candidate, err))
+            print(
+                f"warning: checkpoint ckpt_{candidate} in {directory} failed "
+                f"to restore ({type(err).__name__}: {err}); trying older"
+            )
+        elif multi:
+            print(
+                f"warning: checkpoint ckpt_{candidate} restored locally but "
+                f"failed on a peer process; trying older"
+            )
+    raise RuntimeError(
+        f"all {len(steps)} checkpoints under {directory} failed to restore"
+        + (f"; newest local error: {errors[0][1]!r}" if errors else
+           " (failures were on peer processes)")
+    )
+
+
+def _restore_one(directory: str, template: Any, step: int) -> Any:
     path = _ckpt_path(directory, step)
     # Only the template's structure/shapes/dtypes matter (the deserializer
     # overwrites every value) — build host zeros rather than fetching (or,
@@ -230,4 +345,4 @@ def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None
     # free to place them per its shardings — orbax otherwise commits
     # everything to device 0, which conflicts with a multi-device mesh.
     restored = jax.device_get(restored)
-    return _rewrap_keys(template, restored), step
+    return _rewrap_keys(template, restored)

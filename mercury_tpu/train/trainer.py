@@ -273,60 +273,65 @@ class Trainer:
             return bool(every) and (at // every) > ((at - advanced) // every)
 
         try:
-          while step < end:
-            if self.train_step_many is not None and step + self.scan_steps <= end:
-                k = self.scan_steps
-                self.state, metrics = self.train_step_many(
-                    self.state,
-                    self.dataset.x_train,
-                    self.dataset.y_train,
-                    self.dataset.shard_indices,
-                )
-                metrics = {name: v[-1] for name, v in metrics.items()}
-            else:
-                k = 1
-                self.state, metrics = self.train_step(
-                    self.state,
-                    self.dataset.x_train,
-                    self.dataset.y_train,
-                    self.dataset.shard_indices,
-                )
-            step += k
-            if crossed(cfg.log_every, step, k):
-                metrics = {name: float(v) for name, v in metrics.items()}
-                now = time.perf_counter()
-                step_time = (now - last_log_t) / max(step - last_log_step, 1)
-                last_log_t, last_log_step = now, step
-                metrics["time/step"] = step_time
-                metrics["time/images_per_sec"] = (
-                    cfg.batch_size * cfg.world_size / step_time
-                )
-                self.logger.log_scalars(step, metrics)
-                epoch = (step - 1) // self.steps_per_epoch
-                print(
-                    f"epoch {epoch} step {step} "
-                    f"loss {metrics['train/loss']:.4f} "
-                    f"acc {metrics['train/acc']:.4f} "
-                    f"step_time {step_time*1000:.1f}ms"
-                )
-            if crossed(cfg.eval_every, step, k):
-                final_metrics = self.evaluate()
-                self.logger.log_scalars(step, final_metrics)
-                print(
-                    f"  eval @ {step}: "
-                    + " ".join(f"{k}={v:.4f}" for k, v in final_metrics.items())
-                )
-            if cfg.checkpoint_dir and crossed(cfg.checkpoint_every, step, k):
-                if cfg.async_checkpoint:
-                    # One in-flight write at a time: join the previous
-                    # before fetching the next snapshot.
-                    if self._ckpt_thread is not None:
-                        self._ckpt_thread.join()
-                    self._ckpt_thread = ckpt.save_checkpoint_async(
-                        cfg.checkpoint_dir, self.state, step
+            while step < end:
+                if self.train_step_many is not None and step + self.scan_steps <= end:
+                    k = self.scan_steps
+                    self.state, metrics = self.train_step_many(
+                        self.state,
+                        self.dataset.x_train,
+                        self.dataset.y_train,
+                        self.dataset.shard_indices,
                     )
                 else:
-                    ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
+                    k = 1
+                    self.state, metrics = self.train_step(
+                        self.state,
+                        self.dataset.x_train,
+                        self.dataset.y_train,
+                        self.dataset.shard_indices,
+                    )
+                step += k
+                if crossed(cfg.log_every, step, k):
+                    # Scanned chunks deliver each metric as a [K] series
+                    # (one entry per step); log the chunk MEAN — keeping
+                    # only the last entry would silently discard (K-1)/K
+                    # of the signal. The reduction happens here, inside
+                    # the log gate, so unlogged chunks dispatch nothing.
+                    metrics = {name: float(jnp.mean(v))
+                               for name, v in metrics.items()}
+                    now = time.perf_counter()
+                    step_time = (now - last_log_t) / max(step - last_log_step, 1)
+                    last_log_t, last_log_step = now, step
+                    metrics["time/step"] = step_time
+                    metrics["time/images_per_sec"] = (
+                        cfg.batch_size * cfg.world_size / step_time
+                    )
+                    self.logger.log_scalars(step, metrics)
+                    epoch = (step - 1) // self.steps_per_epoch
+                    print(
+                        f"epoch {epoch} step {step} "
+                        f"loss {metrics['train/loss']:.4f} "
+                        f"acc {metrics['train/acc']:.4f} "
+                        f"step_time {step_time*1000:.1f}ms"
+                    )
+                if crossed(cfg.eval_every, step, k):
+                    final_metrics = self.evaluate()
+                    self.logger.log_scalars(step, final_metrics)
+                    print(
+                        f"  eval @ {step}: "
+                        + " ".join(f"{k}={v:.4f}" for k, v in final_metrics.items())
+                    )
+                if cfg.checkpoint_dir and crossed(cfg.checkpoint_every, step, k):
+                    if cfg.async_checkpoint:
+                        # One in-flight write at a time: join the previous
+                        # before fetching the next snapshot.
+                        if self._ckpt_thread is not None:
+                            self._ckpt_thread.join()
+                        self._ckpt_thread = ckpt.save_checkpoint_async(
+                            cfg.checkpoint_dir, self.state, step
+                        )
+                    else:
+                        ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
         finally:
             # An exception mid-loop (KeyboardInterrupt, eval error) must not
             # leave a write in flight — a relaunched auto_resume reading a

@@ -300,6 +300,35 @@ class TestScannedSteps:
         tr.fit(num_epochs=1)
         assert int(tr.state.step) == 7
 
+    def test_fit_logs_chunk_means_not_last_step(self, mesh, tmp_path):
+        """With scan_steps=K, fit() logs the MEAN over each K-step chunk —
+        not just the chunk's last step. Cross-checked against per-step
+        losses from an identical unscanned run (same seed ⇒ same steps)."""
+        import json
+
+        cfg = tiny_config(steps_per_epoch=4, eval_every=0, log_every=4)
+        a = Trainer(cfg, mesh=mesh)
+        per_step = []
+        for _ in range(4):
+            a.state, ma = a.train_step(
+                a.state, a.dataset.x_train, a.dataset.y_train,
+                a.dataset.shard_indices,
+            )
+            per_step.append(float(ma["train/loss"]))
+
+        logdir = str(tmp_path / "scanlog")
+        b = Trainer(cfg.replace(scan_steps=4, log_dir=logdir), mesh=mesh)
+        b.fit(num_epochs=1)
+        records = [json.loads(l) for l in
+                   open(f"{logdir}/metrics.jsonl")]
+        logged = [r for r in records if "train/loss" in r]
+        assert logged, "no train/loss logged"
+        np.testing.assert_allclose(
+            logged[0]["train/loss"], np.mean(per_step), rtol=1e-4
+        )
+        # Regression guard: the chunk mean differs from the last step alone.
+        assert abs(np.mean(per_step) - per_step[-1]) > 1e-8
+
 
 class TestNorthStarConfig:
     def test_resnet50_cifar100_8worker_stat_allreduce(self, mesh):
