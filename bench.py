@@ -14,28 +14,110 @@ a faithful *unfused* reproduction of the reference's loop structure — 10
 separate scoring forwards + host-side multinomial + separate train step
 (``pytorch_collab.py:95-117``) — i.e. what a direct port would do.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Resilience (driver contract — ONE JSON line, rc 0):
+the tunneled chip's backend drops for hours at a time, and a dead tunnel
+HANGS first contact rather than raising, so the backend is probed in a
+subprocess with a hard timeout. Every successful real-chip run persists to
+``bench_last_good.json``; when the chip is unreachable the benchmark emits
+that record (marked ``"stale": true``) instead of dying, and with no cache
+either it degrades to a scaled-down CPU run (marked ``"degraded": true``)
+so the round always captures an artifact.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-import optax
 
-BATCH = 32
-POOL_BATCHES = 10
-WARMUP = 5
-STEPS = 30
-SCAN = 25          # steps fused per dispatch for the headline measurement
-SCAN_CALLS = 8     # timed dispatches → 200 steps
+HEADLINE_METRIC = "resnet18_cifar10_mercury_is_train_throughput"
+LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_last_good.json")
+
+# Peak dense-matmul FLOPs/s per chip for the MFU estimate, by device_kind
+# prefix (bf16 except where noted).
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,        # Trillium
+}
 
 
-def _build(use_is: bool = True, scan_steps: int = 1, **kw):
+def _scale(platform: str) -> dict:
+    """Measurement sizes. The real chip gets the full headline protocol;
+    CPU (verify runs / degraded fallback) gets a contract-true but small
+    protocol so it finishes in minutes, not hours."""
+    if platform == "tpu":
+        return dict(batch=32, pool=10, warmup=5, steps=30, scan=25,
+                    scan_calls=8, all_arms=True)
+    # CPU: one IS step is ~60s and compiling a scanned chunk takes tens of
+    # minutes, so the degraded protocol is unscanned and minimal — it
+    # certifies the contract (one JSON line, real measurement), not perf.
+    return dict(batch=32, pool=10, warmup=1, steps=2, scan=1,
+                scan_calls=1, all_arms=False)
+
+
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "Connection", "connection", "refused",
+                      "transport", "DEADLINE", "Timeout")
+
+
+def _probe_backend(timeout: float = 120.0) -> str:
+    """Touch the platform's backend in a SUBPROCESS with a hard timeout.
+    A dead tunnel hangs ``jax.devices()`` indefinitely (no exception to
+    retry on), so an in-process probe would hang the driver with it.
+
+    Returns ``"ok"``, ``"transient"`` (hang or connection-class error —
+    worth retrying), or ``"permanent"`` (fast failure with a
+    non-connection error: driver/plugin mismatch etc. — retrying masks
+    the real bug)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        if r.returncode == 0:
+            return "ok"
+        if any(m in r.stderr for m in _TRANSIENT_MARKERS):
+            return "transient"
+        print(f"# backend probe failed permanently:\n{r.stderr[-2000:]}",
+              file=sys.stderr)
+        return "permanent"
+    except subprocess.TimeoutExpired:
+        return "transient"  # dead tunnel: first contact hangs
+
+
+def _wait_for_backend(max_wait: float) -> bool:
+    """Retry the subprocess probe with backoff until the backend answers
+    or the budget runs out. Returns whether the backend is usable.
+    Permanent probe failures (non-connection errors) bail immediately —
+    burning the retry budget would only mask a config bug as
+    'unreachable'."""
+    deadline = time.monotonic() + max_wait
+    delay = 15.0
+    while True:
+        status = _probe_backend()
+        if status == "ok":
+            return True
+        if status == "permanent":
+            return False
+        if time.monotonic() + delay > deadline:
+            return False
+        print(f"# backend unreachable; retrying in {delay:.0f}s",
+              file=sys.stderr)
+        time.sleep(delay)
+        delay = min(delay * 2, 120.0)
+
+
+def _build(sc: dict, use_is: bool = True, scan_steps: int = 1, **kw):
     from mercury_tpu.config import TrainConfig
     from mercury_tpu.parallel.mesh import make_mesh
     from mercury_tpu.train.trainer import Trainer
@@ -45,10 +127,10 @@ def _build(use_is: bool = True, scan_steps: int = 1, **kw):
         model="resnet18",
         dataset="synthetic",
         world_size=1,
-        batch_size=BATCH,
-        presample_batches=POOL_BATCHES,
+        batch_size=sc["batch"],
+        presample_batches=sc["pool"],
         use_importance_sampling=use_is,
-        steps_per_epoch=STEPS,
+        steps_per_epoch=sc["steps"],
         num_epochs=1,
         eval_every=0,
         log_every=0,
@@ -59,7 +141,25 @@ def _build(use_is: bool = True, scan_steps: int = 1, **kw):
     return Trainer(config, mesh=mesh)
 
 
-def bench_fused(trainer) -> float:
+def _step_flops(trainer) -> float:
+    """FLOPs of one dispatch of the measured step, from XLA's compiled
+    cost analysis. Returns 0.0 when the platform doesn't report it."""
+    try:
+        ds = trainer.dataset
+        step_fn = trainer.train_step_many or trainer.train_step
+        cost = step_fn.lower(
+            trainer.state, ds.x_train, ds.y_train, ds.shard_indices
+        ).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax: one dict per device
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+    except Exception as e:  # pragma: no cover - depends on platform
+        print(f"# cost_analysis unavailable: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 0.0
+
+
+def bench_fused(trainer, sc: dict) -> float:
     """Throughput of the fused step; with config.scan_steps > 1 each
     dispatch advances a whole K-step chunk (one host round-trip per chunk —
     the TPU-native answer to being dispatch-latency-bound at batch 32)."""
@@ -67,10 +167,10 @@ def bench_fused(trainer) -> float:
     state = trainer.state
     step_fn = trainer.train_step_many or trainer.train_step
     k = trainer.scan_steps
-    calls = SCAN_CALLS if k > 1 else STEPS
+    calls = sc["scan_calls"] if k > 1 else sc["steps"]
     # Warmup covers both compiles: the initial one, and the recompile when
     # the donated output layout first feeds back as the input layout.
-    for _ in range(3 if k > 1 else WARMUP):
+    for _ in range(3 if k > 1 else sc["warmup"]):
         state, metrics = step_fn(state, ds.x_train, ds.y_train, ds.shard_indices)
         np.asarray(metrics["train/loss"])
     # Timing fence = host fetch of the final loss: on the tunneled-chip
@@ -82,19 +182,23 @@ def bench_fused(trainer) -> float:
     np.asarray(metrics["train/loss"])
     dt = time.perf_counter() - t0
     trainer.state = state
-    return BATCH * calls * k / dt
+    return sc["batch"] * calls * k / dt
 
 
-def bench_unfused(trainer) -> float:
+def bench_unfused(trainer, sc: dict) -> float:
     """Reference-loop-shaped baseline: 10 separate jitted scoring forwards
     with host-side accumulation + host-side multinomial + separate jitted
     train step (the structure of ``update_samples`` + ``train``,
     ``pytorch_collab.py:89-164``)."""
-    from mercury_tpu.sampling.importance import per_sample_loss, reweighted_loss
+    import jax
+    import jax.numpy as jnp
+    import optax
 
     from mercury_tpu.models import create_model
+    from mercury_tpu.sampling.importance import per_sample_loss, reweighted_loss
 
     ds, cfg = trainer.dataset, trainer.config
+    batch, pool = sc["batch"], sc["pool"]
     # Local (unsynced) BN, like the reference's per-worker nets — and this
     # baseline runs under plain jit, outside any mesh axis.
     model = create_model(cfg.model, num_classes=ds.num_classes,
@@ -132,8 +236,8 @@ def bench_unfused(trainer) -> float:
 
     def one_step(params, batch_stats, opt_state):
         losses, datas, labels = [], [], []
-        for _ in range(POOL_BATCHES):  # 10 separate device calls (:95)
-            idx = host_rng.integers(0, n_train, BATCH)
+        for _ in range(pool):  # 10 separate device calls (:95)
+            idx = host_rng.integers(0, n_train, batch)
             img = jnp.asarray(x[idx])
             lab = jnp.asarray(y[idx])
             losses.append(np.asarray(score_one(params, batch_stats, img, lab)))
@@ -142,57 +246,31 @@ def bench_unfused(trainer) -> float:
         pool_losses = np.concatenate(losses)  # host cat (:108)
         scores = pool_losses + 0.5 * pool_losses.mean()
         probs = scores / scores.sum()
-        sel = host_rng.choice(len(probs), BATCH, replace=True, p=probs)  # host multinomial (:114)
+        sel = host_rng.choice(len(probs), batch, replace=True, p=probs)  # host multinomial (:114)
         pool_x = jnp.concatenate(datas)
         pool_y = jnp.concatenate(labels)
         scaled = jnp.asarray(probs[sel] * len(probs), jnp.float32)
         return train_one(params, batch_stats, opt_state,
                          pool_x[sel], pool_y[sel], scaled)
 
-    for _ in range(WARMUP):
+    for _ in range(sc["warmup"]):
         params, batch_stats, opt_state, loss = one_step(params, batch_stats, opt_state)
     np.asarray(loss)
     t0 = time.perf_counter()
-    for _ in range(STEPS):
+    for _ in range(sc["steps"]):
         params, batch_stats, opt_state, loss = one_step(params, batch_stats, opt_state)
     np.asarray(loss)
     dt = time.perf_counter() - t0
-    return BATCH * STEPS / dt
+    return sc["batch"] * sc["steps"] / dt
 
 
-def _wait_for_backend(max_wait: float = 600.0) -> None:
-    """The tunneled chip's remote-compile endpoint can drop transiently
-    (connection-refused at first compile); retry a trivial computation with
-    backoff instead of dying, so a momentary outage doesn't cost the
-    round's benchmark record."""
-    import sys
+def _run_bench() -> dict:
+    """The measurement itself. Assumes the backend is reachable."""
+    import jax
 
-    deadline = time.monotonic() + max_wait
-    delay = 5.0
-    while True:
-        try:
-            float(jnp.ones((8,), jnp.float32).sum())
-            return
-        except Exception as e:  # pragma: no cover - depends on platform
-            transient = any(
-                s in str(e)
-                for s in ("UNAVAILABLE", "Connection", "connection",
-                          "transport", "refused", "DEADLINE")
-            )
-            if not transient or time.monotonic() + delay > deadline:
-                raise  # permanent failure (driver/plugin mismatch): fail fast
-            print(
-                f"# backend not ready ({type(e).__name__}); "
-                f"retrying in {delay:.0f}s", file=sys.stderr,
-            )
-            time.sleep(delay)
-            delay = min(delay * 2, 60.0)
-
-
-def main():
-    import sys
-
-    _wait_for_backend()
+    dev = jax.devices()[0]
+    platform = dev.platform
+    sc = _scale(platform)
 
     def arm(label, fn):
         """Optional diagnostic arm: a failure must not kill the headline
@@ -204,35 +282,166 @@ def main():
                   file=sys.stderr)
             return None
 
-    trainer = _build(use_is=True, scan_steps=SCAN)
-    fused_ips = bench_fused(trainer)
-    pipelined_ips = arm("pipelined", lambda: bench_fused(
-        _build(use_is=True, scan_steps=SCAN, pipelined_scoring=True)))
-    uniform_ips = bench_fused(_build(use_is=False, scan_steps=SCAN))
-    per_step_trainer = _build(use_is=True)
-    per_step_ips = arm("per_step", lambda: bench_fused(per_step_trainer))
-    unfused_ips = arm("unfused", lambda: bench_unfused(per_step_trainer))
+    trainer = _build(sc, use_is=True, scan_steps=sc["scan"])
+    fused_ips = bench_fused(trainer, sc)
+    # FLOPs AFTER the timing: .lower().compile() is an AOT path that does
+    # not share the jit dispatch cache, so doing it first would pay the
+    # scan-chunk compile twice before any measurement. With the persistent
+    # compilation cache enabled (main()) this compile is a disk hit.
+    flops_per_dispatch = _step_flops(trainer)
+    uniform_ips = bench_fused(_build(sc, use_is=False, scan_steps=sc["scan"]), sc)
+    pipelined_ips = per_step_ips = unfused_ips = None
+    if sc["all_arms"]:
+        pipelined_ips = arm("pipelined", lambda: bench_fused(
+            _build(sc, use_is=True, scan_steps=sc["scan"],
+                   pipelined_scoring=True), sc))
+        per_step_trainer = _build(sc, use_is=True)
+        per_step_ips = arm("per_step",
+                           lambda: bench_fused(per_step_trainer, sc))
+        unfused_ips = arm("unfused",
+                          lambda: bench_unfused(per_step_trainer, sc))
     headline_ips = max(fused_ips, pipelined_ips or 0.0)  # best IS variant
 
+    # MFU: FLOPs/img (from the compiled step) × img/s ÷ chip peak.
+    mfu = None
+    peak = next((v for k, v in PEAK_FLOPS.items()
+                 if dev.device_kind.startswith(k)), None)
+    if flops_per_dispatch > 0 and peak:
+        flops_per_img = flops_per_dispatch / (sc["batch"] * sc["scan"])
+        mfu = round(flops_per_img * headline_ips / peak, 4)
+
     def fmt(v):
-        return f"{v:.1f}" if v else "failed"
+        return f"{v:.1f}" if v else "n/a"
 
     print(
-        f"# diagnostics: fused_is_scan{SCAN}={fused_ips:.1f} "
-        f"pipelined_is_scan{SCAN}={fmt(pipelined_ips)} "
-        f"uniform_sgd_scan{SCAN}={uniform_ips:.1f} "
+        f"# diagnostics [{platform}/{dev.device_kind}]: "
+        f"fused_is_scan{sc['scan']}={fused_ips:.1f} "
+        f"pipelined_is_scan{sc['scan']}={fmt(pipelined_ips)} "
+        f"uniform_sgd_scan{sc['scan']}={uniform_ips:.1f} "
         f"fused_is_per_step_dispatch={fmt(per_step_ips)} "
         f"unfused_reference_loop={fmt(unfused_ips)} img/s"
         + (f" (fused vs unfused: {fused_ips / unfused_ips:.1f}x)"
            if unfused_ips else ""),
         file=sys.stderr,
     )
-    print(json.dumps({
-        "metric": "resnet18_cifar10_mercury_is_train_throughput",
+    record = {
+        "metric": HEADLINE_METRIC,
         "value": round(headline_ips, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(headline_ips / uniform_ips, 3),
-    }))
+        "mfu": mfu,
+        "platform": platform,
+        "device_kind": dev.device_kind,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if platform != "tpu":
+        record["degraded"] = True  # scaled-down CPU protocol, not the chip
+    return record
+
+
+def _load_last_good() -> dict | None:
+    try:
+        with open(LAST_GOOD) as f:
+            rec = json.load(f)
+        return rec if rec.get("metric") == HEADLINE_METRIC else None
+    except Exception:
+        return None
+
+
+def _save_last_good(record: dict) -> None:
+    tmp = LAST_GOOD + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f)
+    os.replace(tmp, LAST_GOOD)
+
+
+def _cpu_fallback_record() -> dict | None:
+    """Measure on host CPU in a FRESH subprocess. In this process the
+    (dead) platform backend may already be initialized, and
+    ``jax.config.update("jax_platforms", ...)`` after first backend touch
+    is a silent no-op — a second in-process run would dispatch straight
+    back to the dead backend and hang."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MERCURY_BENCH_CHILD="1",
+               PALLAS_AXON_POOL_IPS="")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=1800, env=env,
+        )
+        sys.stderr.write(r.stderr[-4000:])
+        if r.returncode == 0 and r.stdout.strip():
+            return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        print(f"# cpu fallback failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    return None
+
+
+def main():
+    # Persistent compile cache: scan-chunk compiles are minutes-long (and
+    # on the real chip go over a flaky remote-compile tunnel) — cache them
+    # across runs and across the timing/cost-analysis double compile.
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    ".jax_cache")),
+    )
+
+    if os.environ.get("MERCURY_BENCH_CHILD"):
+        # Fallback child: measure on whatever platform the env selects
+        # (CPU) and print the record; the parent wraps it.
+        record = _run_bench()
+        record["stale_reason"] = "tpu backend unreachable; host-CPU fallback"
+        print(json.dumps(record))
+        return
+
+    max_wait = float(os.environ.get("MERCURY_BENCH_WAIT", "900"))
+    backend_up = _wait_for_backend(max_wait)
+
+    record = None
+    if backend_up:
+        try:
+            record = _run_bench()
+        except Exception as e:
+            print(f"# bench run failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    if record is not None and record.get("platform") == "tpu":
+        _save_last_good(record)
+
+    if record is None:
+        cached = _load_last_good()
+        if cached is not None:
+            cached["stale"] = True
+            cached["stale_reason"] = (
+                "backend unreachable at bench time; last good real-chip "
+                f"result from {cached.get('timestamp', 'unknown')}"
+            )
+            record = cached
+            print("# emitting cached last-good real-chip result (stale)",
+                  file=sys.stderr)
+
+    if record is None:
+        # Last resort: measure on host CPU so the round still captures a
+        # contract-valid artifact.
+        print("# no cache; degrading to host-CPU measurement",
+              file=sys.stderr)
+        record = _cpu_fallback_record()
+
+    if record is None:
+        # Even the CPU child failed — emit a contract-shaped failure
+        # record rather than dying without the JSON line.
+        record = {
+            "metric": HEADLINE_METRIC, "value": 0.0,
+            "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "failed": True,
+            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -176,6 +176,7 @@ class ShardedDataset:
     mean: np.ndarray
     std: np.ndarray
     num_classes: int
+    synthetic: bool = True    # False when loaded from real on-disk bytes
 
     @property
     def n_train(self) -> int:
@@ -205,6 +206,7 @@ def make_sharded_dataset(
     mean: np.ndarray,
     std: np.ndarray,
     num_classes: int,
+    synthetic: bool = True,
 ) -> ShardedDataset:
     """Build a :class:`ShardedDataset` from host arrays + partition output.
 
@@ -232,6 +234,7 @@ def make_sharded_dataset(
         mean=mean,
         std=std,
         num_classes=num_classes,
+        synthetic=synthetic,
     )
 
 

@@ -72,7 +72,8 @@ def build_dataset(config: TrainConfig, seed_offset: int = 0) -> ShardedDataset:
         min_size=config.min_shard_size,
     )
     return make_sharded_dataset(
-        train, test, shards, info["mean"], info["std"], info["num_classes"]
+        train, test, shards, info["mean"], info["std"], info["num_classes"],
+        synthetic=info.get("synthetic", True),
     )
 
 

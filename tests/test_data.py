@@ -90,6 +90,142 @@ class TestCifarLoad:
         assert np.mean(same) > np.mean(diff) + 0.05
 
 
+def _fake_cifar_images(n, num_classes, seed):
+    """Known uint8 NHWC images + labels for byte-exactness checks."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, (n, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    return x, y
+
+
+def _write_pickle_batch(path, x_nhwc, labels, label_key):
+    """Serialize in the on-disk CIFAR batch layout: uint8 rows of 3072
+    bytes, channel-major (R plane, G plane, B plane) — the format
+    torchvision unpickles for the reference (data_loader.py:114-123)."""
+    import pickle
+
+    rows = x_nhwc.transpose(0, 3, 1, 2).reshape(len(x_nhwc), -1)
+    with open(path, "wb") as f:
+        pickle.dump({"data": rows, label_key: labels.tolist()}, f)
+
+
+class TestRealCifarIngest:
+    """The real-data byte path (cifar.py pickle/tar/npz ingest): fixture
+    files in the standard formats must come back byte-exact NHWC uint8.
+    This code otherwise only runs the day real data appears."""
+
+    def test_pickle_batches_byte_exact(self, tmp_path):
+        bdir = tmp_path / "cifar-10-batches-py"
+        bdir.mkdir()
+        xs, ys = [], []
+        for i in range(1, 6):
+            x, y = _fake_cifar_images(8, 10, seed=i)
+            _write_pickle_batch(bdir / f"data_batch_{i}", x, y, "labels")
+            xs.append(x)
+            ys.append(y)
+        xt, yt = _fake_cifar_images(6, 10, seed=99)
+        _write_pickle_batch(bdir / "test_batch", xt, yt, "labels")
+
+        train, test, info = load_dataset(
+            "cifar10", data_dir=str(tmp_path), allow_synthetic=False
+        )
+        assert info["synthetic"] is False and info["num_classes"] == 10
+        x_train, y_train = train
+        assert x_train.dtype == np.uint8 and x_train.shape == (40, 32, 32, 3)
+        np.testing.assert_array_equal(x_train, np.concatenate(xs))
+        np.testing.assert_array_equal(y_train, np.concatenate(ys))
+        np.testing.assert_array_equal(test[0], xt)
+        np.testing.assert_array_equal(test[1], yt)
+
+    def test_targz_extraction(self, tmp_path):
+        """A cifar-10-python.tar.gz in the data root is extracted and then
+        loaded through the same pickle path."""
+        import tarfile
+
+        stage = tmp_path / "stage" / "cifar-10-batches-py"
+        stage.mkdir(parents=True)
+        batches = {}
+        for i in range(1, 6):
+            x, y = _fake_cifar_images(4, 10, seed=10 + i)
+            _write_pickle_batch(stage / f"data_batch_{i}", x, y, "labels")
+            batches[i] = (x, y)
+        xt, yt = _fake_cifar_images(4, 10, seed=50)
+        _write_pickle_batch(stage / "test_batch", xt, yt, "labels")
+
+        root = tmp_path / "root"
+        root.mkdir()
+        with tarfile.open(root / "cifar-10-python.tar.gz", "w:gz") as tf:
+            tf.add(stage, arcname="cifar-10-batches-py")
+
+        train, _, info = load_dataset(
+            "cifar10", data_dir=str(root), allow_synthetic=False
+        )
+        assert info["synthetic"] is False
+        np.testing.assert_array_equal(train[0][:4], batches[1][0])
+
+    def test_npz_cache_byte_exact(self, tmp_path):
+        x, y = _fake_cifar_images(16, 10, seed=7)
+        xt, yt = _fake_cifar_images(8, 10, seed=8)
+        np.savez(tmp_path / "cifar10.npz", x_train=x, y_train=y,
+                 x_test=xt, y_test=yt)
+        train, test, info = load_dataset(
+            "cifar10", data_dir=str(tmp_path), allow_synthetic=False
+        )
+        assert info["synthetic"] is False
+        np.testing.assert_array_equal(train[0], x)
+        np.testing.assert_array_equal(train[1], y)
+        np.testing.assert_array_equal(test[0], xt)
+        assert train[1].dtype == np.int32
+
+    def test_cifar100_fine_labels(self, tmp_path):
+        bdir = tmp_path / "cifar-100-python"
+        bdir.mkdir()
+        x, y = _fake_cifar_images(12, 100, seed=3)
+        _write_pickle_batch(bdir / "train", x, y, "fine_labels")
+        xt, yt = _fake_cifar_images(6, 100, seed=4)
+        _write_pickle_batch(bdir / "test", xt, yt, "fine_labels")
+        train, test, info = load_dataset(
+            "cifar100", data_dir=str(tmp_path), allow_synthetic=False
+        )
+        assert info["num_classes"] == 100 and info["synthetic"] is False
+        np.testing.assert_array_equal(train[0], x)
+        np.testing.assert_array_equal(train[1], y)
+        np.testing.assert_array_equal(test[1], yt)
+
+    def test_no_data_raises_when_synthetic_disallowed(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="MERCURY_TPU_DATA"):
+            load_dataset("cifar10", data_dir=str(tmp_path),
+                         allow_synthetic=False)
+
+    def test_trainer_end_to_end_on_fixture_data(self, tmp_path):
+        """The full Trainer path consumes fixture 'real' CIFAR: ingest →
+        partition → sharded dataset → one IS train step."""
+        from mercury_tpu.config import TrainConfig
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+        from mercury_tpu.train.trainer import Trainer
+
+        bdir = tmp_path / "cifar-10-batches-py"
+        bdir.mkdir()
+        for i in range(1, 6):
+            x, y = _fake_cifar_images(64, 10, seed=20 + i)
+            _write_pickle_batch(bdir / f"data_batch_{i}", x, y, "labels")
+        xt, yt = _fake_cifar_images(32, 10, seed=60)
+        _write_pickle_batch(bdir / "test_batch", xt, yt, "labels")
+
+        cfg = TrainConfig(model="smallcnn", dataset="cifar10",
+                          data_dir=str(tmp_path), world_size=4, batch_size=4,
+                          presample_batches=2, steps_per_epoch=1, num_epochs=1,
+                          eval_every=0, log_every=0, compute_dtype="float32",
+                          seed=0)
+        tr = Trainer(cfg, mesh=host_cpu_mesh(4))
+        assert tr.dataset.synthetic is False
+        tr.state, m = tr.train_step(
+            tr.state, tr.dataset.x_train, tr.dataset.y_train,
+            tr.dataset.shard_indices,
+        )
+        assert np.isfinite(float(m["train/loss"]))
+
+
 class TestPipeline:
     def test_normalize(self):
         img = np.full((2, 32, 32, 3), 255, np.uint8)
