@@ -32,6 +32,16 @@ class TrainConfig:
     # Parallelism -----------------------------------------------------------
     world_size: int = 4              # number of data-parallel workers (mesh size)
     mesh_axis: str = "data"          # name of the data-parallel mesh axis
+    # Tensor parallelism WITHIN each data-parallel worker: a second mesh
+    # axis of this size carries the Megatron column/row split of every
+    # transformer block (parallel/tensor.py). The Mercury IS step runs
+    # manual-SPMD over the data axis and leaves the model axis to GSPMD,
+    # so scoring forward, draw, reweighted backward, and the stat psum all
+    # execute TP-sharded. Requires model="transformer" and
+    # num_heads % tensor_parallel == 0; total devices =
+    # world_size × tensor_parallel.
+    tensor_parallel: int = 1
+    model_axis: str = "model"        # name of the tensor-parallel mesh axis
 
     # Optimization ----------------------------------------------------------
     batch_size: int = 32             # per-worker train batch (exp_dataset.py:11,24)

@@ -38,6 +38,31 @@ def make_mesh(
     return Mesh(mesh_devices, (axis_name,))
 
 
+def make_tp_mesh(
+    world_size: int,
+    tensor_parallel: int,
+    data_axis: str = "data",
+    model_axis: str = "model",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """2-D ``data × model`` mesh for composing data parallelism with
+    tensor parallelism (``world_size × tensor_parallel`` devices). The
+    model axis is placed innermost so TP's frequent block-level
+    collectives ride the fastest ICI links."""
+    if devices is None:
+        devices = jax.devices()
+    need = world_size * tensor_parallel
+    if need > len(devices):
+        raise ValueError(
+            f"requested {world_size}×{tensor_parallel}={need} devices, "
+            f"have {len(devices)}"
+        )
+    mesh_devices = mesh_utils.create_device_mesh(
+        (world_size, tensor_parallel), devices=list(devices)[:need]
+    )
+    return Mesh(mesh_devices, (data_axis, model_axis))
+
+
 def data_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
     """Shard the leading (per-worker) axis across the mesh."""
     return NamedSharding(mesh, PartitionSpec(axis_name))

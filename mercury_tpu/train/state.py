@@ -59,6 +59,7 @@ def create_state(
     pending_batch_size: int = 0,
     pending_sample_shape: Optional[tuple] = None,
     zero_sharding: bool = False,
+    init_opt: bool = True,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -87,8 +88,13 @@ def create_state(
             ),
             chunk_state,
         )
-    else:
+    elif init_opt:
         opt_state = tx.init(params)
+    else:
+        # Caller re-derives the optimizer state from re-placed params
+        # (e.g. tensor-parallel layout) — don't allocate a replicated
+        # moment tree just to discard it.
+        opt_state = None
     ema0 = init_ema()
     ema = EMAState(
         value=jnp.zeros((n_workers,), jnp.float32) + ema0.value,

@@ -67,6 +67,34 @@ def _state_specs(
     )
 
 
+def mercury_state_out_shardings(
+    mesh: Mesh, axis: str, params_sh, opt_sh,
+    has_groupwise: bool = False, has_pending: bool = False,
+) -> Tuple[MercuryState, Any]:
+    """Output shardings pinning the post-step state layout under partial-
+    auto meshes (dp×tp): without this, GSPMD is free to re-replicate the
+    tensor-parallel params on every step's output, silently discarding the
+    TP memory/compute split. ``params_sh``/``opt_sh`` are the committed
+    input sharding trees; everything else follows :func:`_state_specs`."""
+    from jax.sharding import NamedSharding
+
+    def n(spec):
+        return NamedSharding(mesh, spec)
+
+    state_sh = MercuryState(
+        step=n(P()),
+        params=params_sh,
+        batch_stats=n(P()),
+        opt_state=opt_sh,
+        ema=EMAState(value=n(P(axis)), count=n(P(axis))),
+        stream=ShardStream(perm=n(P(axis)), cursor=n(P(axis))),
+        rng=n(P(axis)),
+        groupwise=n(P(axis)) if has_groupwise else None,
+        pending=n(P(axis)) if has_pending else None,
+    )
+    return state_sh, n(P())
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -75,6 +103,7 @@ def make_train_step(
     mean: np.ndarray,
     std: np.ndarray,
     scan_steps: int = 1,
+    state_out_shardings=None,
 ) -> Callable[..., Tuple[MercuryState, Dict[str, jax.Array]]]:
     """Build the jitted train step.
 
@@ -93,6 +122,27 @@ def make_train_step(
     pool_size = config.candidate_pool_size if use_is else config.batch_size
     batch_size = config.batch_size
     stat_axis = axis if (use_is and config.sync_importance_stats) else None
+
+    # Mesh axes beyond the data axis (e.g. the "model" axis of a dp×tp
+    # mesh) are left to GSPMD: the step is manual-SPMD over `axis` only,
+    # and XLA partitions the forwards/backwards over the auto axes per the
+    # params' committed shardings (transformer_tp_shardings). This is how
+    # the flagship IS algorithm composes with tensor parallelism — the
+    # scoring forward, draw, reweighted backward, and stat psum all run
+    # TP-sharded without any change to the body below.
+    auto_axes = [a for a in mesh.axis_names if a != axis]
+    tp_active = any(mesh.shape[a] > 1 for a in auto_axes)
+    if tp_active and config.zero_sharding:
+        raise ValueError(
+            "zero_sharding flattens params to a vector, which would force "
+            "an all-gather of tensor-parallel shards; use FSDP or plain "
+            "allreduce with tensor_parallel > 1"
+        )
+    if tp_active and config.grad_compression == "int8":
+        raise ValueError(
+            "grad_compression='int8' (ring ppermute on flattened grads) "
+            "does not compose with tensor_parallel > 1"
+        )
 
     use_pallas = config.use_pallas
     if use_pallas is None:  # auto: Mosaic kernels on real TPU only
@@ -459,14 +509,22 @@ def make_train_step(
 
     specs = _state_specs(axis, has_groupwise=use_groupwise,
                          has_pending=pipelined, zero_sharding=zero)
+    smap_kw = {}
+    if auto_axes:
+        # Manual over the data axis only; GSPMD handles the rest.
+        smap_kw["axis_names"] = frozenset({axis})
     sharded = shard_map(
         fn,
         mesh=mesh,
         in_specs=(specs, P(), P(), P(axis)),
         out_specs=(specs, P()),
         check_vma=False,
+        **smap_kw,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    jit_kw = {}
+    if state_out_shardings is not None:
+        jit_kw["out_shardings"] = state_out_shardings
+    return jax.jit(sharded, donate_argnums=(0,), **jit_kw)
 
 
 def make_eval_step(model) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array]]:

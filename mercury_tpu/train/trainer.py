@@ -86,12 +86,40 @@ class Trainer:
     ) -> None:
         self.config = config
         self.dataset = dataset if dataset is not None else build_dataset(config)
-        self.mesh = mesh if mesh is not None else make_mesh(config.world_size, config.mesh_axis)
+        tp = config.tensor_parallel
+        if mesh is not None:
+            self.mesh = mesh
+        elif tp > 1:
+            from mercury_tpu.parallel.mesh import make_tp_mesh
+
+            self.mesh = make_tp_mesh(config.world_size, tp,
+                                     config.mesh_axis, config.model_axis)
+        else:
+            self.mesh = make_mesh(config.world_size, config.mesh_axis)
         if self.mesh.shape[config.mesh_axis] != config.world_size:
             raise ValueError(
                 f"mesh axis size {self.mesh.shape[config.mesh_axis]} != "
                 f"world_size {config.world_size}"
             )
+        if tp > 1:
+            if config.model != "transformer":
+                raise ValueError(
+                    f"tensor_parallel requires model='transformer', got "
+                    f"{config.model!r}"
+                )
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "tensor_parallel > 1 is single-controller only for "
+                    "now: the TP placement targets the full mesh, which "
+                    "globalize_state's replicated re-placement would undo"
+                )
+            if config.model_axis not in self.mesh.axis_names or (
+                self.mesh.shape[config.model_axis] != tp
+            ):
+                raise ValueError(
+                    f"mesh must carry a {config.model_axis!r} axis of size "
+                    f"{tp}; mesh axes: {dict(self.mesh.shape)}"
+                )
 
         if (
             config.num_classes is not None
@@ -169,7 +197,54 @@ class Trainer:
                                   if config.augmentation == "iid"
                                   else sample_shape),
             zero_sharding=config.zero_sharding,
+            init_opt=(tp == 1),
         )
+        if tp > 1:
+            # Commit params in the Megatron column/row TP layout and
+            # re-derive the optimizer state from the sharded params (its
+            # moments inherit the layout). The train step is manual-SPMD
+            # over the data axis only, so GSPMD reads these committed
+            # shardings and partitions every block matmul over the model
+            # axis (parallel/tensor.py).
+            from mercury_tpu.parallel.tensor import transformer_tp_shardings
+
+            if self.model.num_heads % tp != 0:
+                raise ValueError(
+                    f"num_heads={self.model.num_heads} must be divisible "
+                    f"by tensor_parallel={tp}"
+                )
+            param_sh = transformer_tp_shardings(self.state.params, self.mesh,
+                                                config.model_axis)
+            tp_params = jax.device_put(self.state.params, param_sh)
+            # create_state skipped tx.init (init_opt=False): the single
+            # init below inherits the TP layout via zeros_like — no
+            # transient replicated moment tree.
+            tp_opt = self.tx.init(tp_params)
+            self.state = self.state.replace(params=tp_params, opt_state=tp_opt)
+            # Moments inherit their param's layout from init-by-zeros_like;
+            # scalar leaves (step counts) come back single-device committed
+            # and must be normalized to mesh-replicated before use as
+            # output constraints.
+            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+            def norm_sh(leaf):
+                s = getattr(leaf, "sharding", None)
+                if isinstance(s, NamedSharding) and s.mesh == self.mesh:
+                    return s
+                return NamedSharding(self.mesh, Pspec())
+
+            opt_sh = jax.tree_util.tree_map(norm_sh, tp_opt)
+            from mercury_tpu.train.step import mercury_state_out_shardings
+
+            self._state_out_shardings = mercury_state_out_shardings(
+                self.mesh, config.mesh_axis, param_sh, opt_sh,
+                has_groupwise=(config.use_importance_sampling
+                               and config.sampler == "groupwise"),
+                has_pending=(config.use_importance_sampling
+                             and config.pipelined_scoring),
+            )
+        else:
+            self._state_out_shardings = None
         # Multi-controller (multi-host) runs: the host-created state and
         # dataset are process-local; re-place them as global arrays over the
         # (cross-process) mesh. Single-process runs skip this — shard_map
@@ -186,7 +261,8 @@ class Trainer:
                 self.dataset, self.mesh, config.mesh_axis
             )
         self.train_step = make_train_step(
-            self.model, self.tx, config, self.mesh, self.dataset.mean, self.dataset.std
+            self.model, self.tx, config, self.mesh, self.dataset.mean,
+            self.dataset.std, state_out_shardings=self._state_out_shardings,
         )
         # K-step chunked variant: one dispatch per config.scan_steps steps
         # (lax.scan over the same body; jit is lazy, so this costs nothing
@@ -205,6 +281,7 @@ class Trainer:
             make_train_step(
                 self.model, self.tx, config, self.mesh,
                 self.dataset.mean, self.dataset.std, scan_steps=self.scan_steps,
+                state_out_shardings=self._state_out_shardings,
             )
             if self.scan_steps > 1
             else None
@@ -213,7 +290,10 @@ class Trainer:
         # Shard eval batches over the mesh so evaluation uses every device
         # (single-controller only: multi-process would need global eval
         # arrays; there the replicated path is correct, just redundant).
-        eval_mesh = self.mesh if jax.process_count() == 1 else None
+        # Under TP the explicit in_shardings would force the TP-sharded
+        # params to replicate; plain jit lets GSPMD partition eval too.
+        eval_mesh = (self.mesh
+                     if jax.process_count() == 1 and tp == 1 else None)
         self.eval_epoch = make_eval_epoch(self.model, self.dataset.mean,
                                           self.dataset.std,
                                           eval_augmentation=config.augmentation
@@ -450,7 +530,8 @@ class Trainer:
                 self.dataset.num_classes,
                 eval_augmentation=self.config.augmentation
                 if self.config.augmentation == "iid" else "none",
-                mesh=self.mesh if jax.process_count() == 1 else None,
+                mesh=(self.mesh if jax.process_count() == 1
+                      and self.config.tensor_parallel == 1 else None),
                 axis=self.config.mesh_axis,
             )
         images_b, labels_b, valid_b = self._eval_arrays(train)
@@ -481,5 +562,16 @@ class Trainer:
             self.state = globalize_state(
                 self.state, self.mesh, self.config.mesh_axis,
                 zero_sharding=self.config.zero_sharding,
+            )
+        elif self._state_out_shardings is not None:
+            # TP: restore_checkpoint returned host-resident arrays — re-
+            # commit the Megatron layout so the first post-resume step hits
+            # the jit cache (the input sharding signature is part of it)
+            # and the layout-stability invariant holds from step one.
+            state_sh, _ = self._state_out_shardings
+            self.state = self.state.replace(
+                params=jax.device_put(self.state.params, state_sh.params),
+                opt_state=jax.device_put(self.state.opt_state,
+                                         state_sh.opt_state),
             )
         return step
