@@ -1,0 +1,415 @@
+"""Head-to-head: the reference's training loop, faithfully reproduced in
+torch, vs mercury_tpu at a matched configuration — same dataset bytes, same
+Dirichlet partition, same algorithm constants.
+
+The torch side mirrors ``/root/reference/pytorch_collab.py:119-199``
+structurally (modern torch APIs, W simulated workers in one process):
+
+- per-worker nets with LOCAL BatchNorm running stats — including the
+  reference's quirk that ``update_samples``'s no_grad scoring forwards run
+  in train mode and mutate the running stats (``:101``);
+- ``update_samples`` (``:89-117``): 10 separate scoring forwards over the
+  worker's presample loader, per-sample CE, per-epoch EMAverage of the mean
+  pool loss (``train()`` creates a fresh ``EMAverage`` each epoch, ``:121``),
+  score ``loss + α·EMA`` (``:111``), normalize, ``torch.multinomial``
+  with replacement (``:114``), return ``p·N`` weights (``:116``);
+- the hot loop (``:127-197``): reweighted CE ``mean(loss/(N·p))``
+  (``:133-145``), backward, flattened-gradient allreduce (``:236-249`` —
+  here an exact in-process mean across the simulated workers), Adam step,
+  next pool scored with the post-allreduce pre-step params (``:158-160``);
+- cosine LR per epoch (``:62,70``), eval on the global train/test loaders
+  every ``eval_every`` steps on worker 0 (``:181``).
+
+The simulation executes workers sequentially, so its wall-clock measures
+the same total compute a gloo run shares across local cores; per-step time
+is additionally reported divided by W ("parallel-adjusted") for the
+throughput comparison.
+
+Usage::
+
+    python benchmarks/reference_repro.py --model smallcnn --steps 400
+    python benchmarks/reference_repro.py --model resnet18 --steps 200
+
+Appends one JSON line per (arm, eval point) plus a summary line to
+``benchmarks/results_reference_repro.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401  (CPU platform + virtual devices for jax)
+
+CIFAR_MEAN = np.array([0.49139968, 0.48215827, 0.44653124], np.float32)
+CIFAR_STD = np.array([0.24703233, 0.24348505, 0.26158768], np.float32)
+
+
+# --------------------------------------------------------------- torch side
+def torch_model(name: str, num_classes: int):
+    import torch.nn as tnn
+
+    if name == "smallcnn":
+        # Mirror of mercury_tpu/models/simple.py SmallCNN: two stride-2
+        # conv-BN-relu stages (16, 32), GAP, linear head.
+        return tnn.Sequential(
+            tnn.Conv2d(3, 16, 3, stride=2, padding=1, bias=False),
+            tnn.BatchNorm2d(16, momentum=0.1),
+            tnn.ReLU(),
+            tnn.Conv2d(16, 32, 3, stride=2, padding=1, bias=False),
+            tnn.BatchNorm2d(32, momentum=0.1),
+            tnn.ReLU(),
+            tnn.AdaptiveAvgPool2d(1),
+            tnn.Flatten(),
+            tnn.Linear(32, num_classes),
+        )
+    if name == "resnet18":
+        return _TorchCifarResNet18(num_classes)
+    raise ValueError(f"unknown model {name!r}")
+
+
+def _torch_resnet_block(cin, cout, stride):
+    import torch.nn as tnn
+
+    class Block(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = tnn.Conv2d(cin, cout, 3, stride=stride, padding=1,
+                                 bias=False)
+            self.b1 = tnn.BatchNorm2d(cout)
+            self.c2 = tnn.Conv2d(cout, cout, 3, stride=1, padding=1,
+                                 bias=False)
+            self.b2 = tnn.BatchNorm2d(cout)
+            self.short = None
+            if stride != 1 or cin != cout:
+                self.short = tnn.Sequential(
+                    tnn.Conv2d(cin, cout, 1, stride=stride, bias=False),
+                    tnn.BatchNorm2d(cout),
+                )
+
+        def forward(self, x):
+            import torch.nn.functional as tF
+
+            y = tF.relu(self.b1(self.c1(x)))
+            y = self.b2(self.c2(y))
+            s = x if self.short is None else self.short(x)
+            return tF.relu(y + s)
+
+    return Block()
+
+
+class _TorchCifarResNet18:
+    """CIFAR-stem ResNet-18 (3×3 stem, 64-128-256-512 at strides
+    1/2/2/2, GAP) — the reference's architecture
+    (``pytorch_model.py:67-113``), written independently in torch."""
+
+    def __new__(cls, num_classes):
+        import torch.nn as tnn
+
+        layers = [
+            tnn.Conv2d(3, 64, 3, stride=1, padding=1, bias=False),
+            tnn.BatchNorm2d(64),
+            tnn.ReLU(),
+        ]
+        cin = 64
+        for cout, stride in ((64, 1), (128, 2), (256, 2), (512, 2)):
+            layers.append(_torch_resnet_block(cin, cout, stride))
+            layers.append(_torch_resnet_block(cout, cout, 1))
+            cin = cout
+        layers += [tnn.AdaptiveAvgPool2d(1), tnn.Flatten(),
+                   tnn.Linear(512, num_classes)]
+        return tnn.Sequential(*layers)
+
+
+class _EMAverage:
+    """Per-epoch EMA of the mean pool loss (``util.py:200-217``):
+    bootstrap on first update, then ``α·ema + (1-α)·v``."""
+
+    def __init__(self, alpha=0.9):
+        self.alpha = alpha
+        self.value = 0.0
+        self.count = 0
+
+    def update(self, v):
+        v = float(v)
+        self.value = v if self.count == 0 else (
+            self.alpha * self.value + (1 - self.alpha) * v
+        )
+        self.count += 1
+
+
+def _augment_np(rng, x_u8):
+    """Reference non-IID train transform (``data_loader.py:83-96``):
+    pad-4 random crop + horizontal flip, then normalize."""
+    n, h, w, _ = x_u8.shape
+    # torchvision RandomCrop(32, padding=4) zero-pads (constant fill=0).
+    padded = np.pad(x_u8, ((0, 0), (4, 4), (4, 4), (0, 0)),
+                    mode="constant")
+    out = np.empty_like(x_u8)
+    ys = rng.integers(0, 9, n)
+    xs = rng.integers(0, 9, n)
+    flips = rng.random(n) < 0.5
+    for i in range(n):
+        img = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+        out[i] = img[:, ::-1] if flips[i] else img
+    return (out.astype(np.float32) / 255.0 - CIFAR_MEAN) / CIFAR_STD
+
+
+def run_reference_torch(data, shards, model_name, steps, eval_every,
+                        batch=32, pool_batches=10, is_alpha=0.5,
+                        lr_scale=True, seed=0, steps_per_epoch=None):
+    """The reference loop on W simulated workers. Returns eval history."""
+    import torch
+    import torch.nn.functional as tF
+
+    torch.manual_seed(seed)
+    torch.set_num_threads(os.cpu_count() or 8)
+    (x_train, y_train), (x_test, y_test) = data
+    W = len(shards)
+    lr = 0.001 * (W if lr_scale else 1)
+
+    nets = []
+    opts = []
+    scheds = []
+    num_classes = int(y_train.max()) + 1
+    spe = steps_per_epoch or max(len(x_train) // batch, 1)
+    epochs = max(-(-steps // spe), 1)
+    for w in range(W):
+        torch.manual_seed(seed + w)  # per-worker init, then averaged
+        net = torch_model(model_name, num_classes)
+        net.train()
+        nets.append(net)
+        opt = torch.optim.Adam(net.parameters(), lr=lr)
+        opts.append(opt)
+        scheds.append(torch.optim.lr_scheduler.CosineAnnealingLR(opt, epochs))
+
+    # average_model (:84-87): start from the cross-worker mean.
+    with torch.no_grad():
+        for ps in zip(*(n.parameters() for n in nets)):
+            mean = sum(p.data for p in ps) / W
+            for p in ps:
+                p.data.copy_(mean)
+
+    # Per-worker wrapping shuffled presample streams over the worker's
+    # Dirichlet shard (the presam_loader of :74-82).
+    streams = []
+    for w in range(W):
+        r = np.random.default_rng(seed * 1000 + w)
+        streams.append({"rng": r, "order": r.permutation(shards[w]),
+                        "pos": 0})
+
+    def next_pool_idx(w, n):
+        s = streams[w]
+        out = []
+        while len(out) < n:
+            if s["pos"] >= len(s["order"]):
+                s["order"] = s["rng"].permutation(s["order"])
+                s["pos"] = 0
+            take = min(n - len(out), len(s["order"]) - s["pos"])
+            out.append(s["order"][s["pos"]:s["pos"] + take])
+            s["pos"] += take
+        return np.concatenate(out)
+
+    aug_rng = np.random.default_rng(seed + 77)
+    sel_rng = torch.Generator().manual_seed(seed + 78)
+
+    def update_samples(w, ema):
+        """:89-117 — 10 scoring forwards (train mode: BN stats mutate),
+        EMA, +α·EMA shift, normalize, multinomial-with-replacement."""
+        losses_l, datas_l, labels_l = [], [], []
+        for _ in range(pool_batches):
+            idx = next_pool_idx(w, batch)
+            imgs = torch.from_numpy(
+                _augment_np(aug_rng, x_train[idx]).transpose(0, 3, 1, 2)
+            ).contiguous()
+            labs = torch.from_numpy(y_train[idx].astype(np.int64))
+            with torch.no_grad():
+                out = nets[w](imgs)  # train mode — running stats update
+                losses_l.append(tF.cross_entropy(out, labs,
+                                                 reduction="none"))
+            datas_l.append(imgs)
+            labels_l.append(labs)
+        pool_losses = torch.cat(losses_l)
+        ema.update(pool_losses.mean())
+        scores = pool_losses + is_alpha * ema.value
+        probs = scores / scores.sum()
+        sel = torch.multinomial(probs, batch, replacement=True,
+                                generator=sel_rng)
+        return (probs[sel] * pool_losses.numel(),
+                torch.cat(datas_l)[sel], torch.cat(labels_l)[sel])
+
+    def evaluate():
+        """:201-234 on worker 0 (rank 0), inference mode."""
+        net = nets[0]
+        net.eval()
+        correct = total = 0
+        loss_sum = 0.0
+        with torch.no_grad():
+            for s in range(0, len(x_test), 256):
+                imgs = (x_test[s:s + 256].astype(np.float32) / 255.0
+                        - CIFAR_MEAN) / CIFAR_STD
+                imgs = torch.from_numpy(
+                    imgs.transpose(0, 3, 1, 2)).contiguous()
+                labs = torch.from_numpy(y_test[s:s + 256].astype(np.int64))
+                out = net(imgs)
+                loss_sum += float(tF.cross_entropy(out, labs,
+                                                   reduction="sum"))
+                correct += int((out.argmax(1) == labs).sum())
+                total += len(labs)
+        net.train()
+        return loss_sum / total, correct / total
+
+    history = []
+    t0 = time.perf_counter()
+    step = 0
+    emas = [None] * W
+    pend = [None] * W
+    done = False
+    for epoch in range(epochs):
+        # train() resets the EMA every epoch (:121) and primes the
+        # pending selection (:125).
+        for w in range(W):
+            emas[w] = _EMAverage()
+            pend[w] = update_samples(w, emas[w])
+        for _ in range(spe):
+            losses_acc = 0.0
+            for w in range(W):
+                probs, i_data, i_label = pend[w]
+                out = nets[w](i_data)
+                losses = tF.cross_entropy(out, i_label, reduction="none")
+                loss = torch.div(losses, probs).mean()  # :137,145
+                opts[w].zero_grad()
+                loss.backward()
+                losses_acc += float(loss.detach())
+                # :158-160 — next pool scored before optimizer.step.
+                pend[w] = update_samples(w, emas[w])
+            # average_gradients (:236-249): exact mean across workers.
+            with torch.no_grad():
+                for ps in zip(*(n.parameters() for n in nets)):
+                    g = sum(p.grad.data for p in ps) / W
+                    for p in ps:
+                        p.grad.data.copy_(g)
+            for w in range(W):
+                opts[w].step()
+            step += 1
+            if step % eval_every == 0 or step == steps:
+                tl, ta = evaluate()
+                history.append({
+                    "arm": "reference_torch", "step": step,
+                    "wallclock_s": time.perf_counter() - t0,
+                    "wallclock_parallel_adjusted_s":
+                        (time.perf_counter() - t0) / W,
+                    "test_loss": round(tl, 4), "test_acc": round(ta, 4),
+                    "train_loss": round(losses_acc / W, 4),
+                })
+                print(f"  torch step {step}: acc={ta:.4f} "
+                      f"({time.perf_counter() - t0:.0f}s)")
+            if step >= steps:
+                done = True
+                break
+        for sc in scheds:
+            sc.step()  # per-epoch cosine (:70)
+        if done:
+            break
+    return history
+
+
+# ------------------------------------------------------------- mercury side
+def run_mercury(model_name, steps, eval_every, world_size, seed=0,
+                steps_per_epoch=None):
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model=model_name, dataset="synthetic", world_size=world_size,
+        batch_size=32, presample_batches=10, noniid=True,
+        dirichlet_alpha=0.5, seed=seed, num_epochs=1000,
+        steps_per_epoch=steps_per_epoch, eval_every=0, log_every=0,
+        compute_dtype="float32",
+        # The reference has NO cross-worker importance-stat exchange and
+        # local (unsynced) BN; match it for apples-to-apples.
+        sync_importance_stats=False, batch_norm="local",
+    )
+    tr = Trainer(cfg)
+    history = []
+    t0 = time.perf_counter()
+    last_loss = float("nan")
+    for step in range(1, steps + 1):
+        tr.state, m = tr.train_step(
+            tr.state, tr.dataset.x_train, tr.dataset.y_train,
+            tr.dataset.shard_indices,
+        )
+        if step % eval_every == 0 or step == steps:
+            last_loss = float(m["train/loss"])
+            ev = tr.evaluate()
+            history.append({
+                "arm": "mercury_tpu", "step": step,
+                "wallclock_s": time.perf_counter() - t0,
+                "test_loss": round(ev["test/eval_loss"], 4),
+                "test_acc": round(ev["test/eval_acc"], 4),
+                "train_loss": round(last_loss, 4),
+            })
+            print(f"  mercury step {step}: acc={ev['test/eval_acc']:.4f} "
+                  f"({time.perf_counter() - t0:.0f}s)")
+    return history, tr
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="smallcnn",
+                    choices=["smallcnn", "resnet18"])
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--eval-every", type=int, default=50)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--steps-per-epoch", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "results_reference_repro.jsonl"))
+    args = ap.parse_args()
+
+    from mercury_tpu.data.cifar import load_dataset
+    from mercury_tpu.data.partition import partition_data
+
+    train, test, info = load_dataset("synthetic", seed=args.seed)
+    shards = partition_data(train[1], args.workers, mode="hetero",
+                            alpha=0.5, seed=args.seed)
+
+    print(f"reference repro: {args.model}, {args.workers} workers, "
+          f"{args.steps} steps")
+    ref_hist = run_reference_torch(
+        (train, test), shards, args.model, args.steps, args.eval_every,
+        seed=args.seed, steps_per_epoch=args.steps_per_epoch,
+    )
+    merc_hist, _ = run_mercury(
+        args.model, args.steps, args.eval_every, args.workers,
+        seed=args.seed, steps_per_epoch=args.steps_per_epoch,
+    )
+
+    summary = {
+        "arm": "summary", "model": args.model, "workers": args.workers,
+        "steps": args.steps, "seed": args.seed,
+        "reference_final_acc": ref_hist[-1]["test_acc"],
+        "mercury_final_acc": merc_hist[-1]["test_acc"],
+        "reference_total_s": round(ref_hist[-1]["wallclock_s"], 1),
+        "reference_parallel_adjusted_s":
+            round(ref_hist[-1]["wallclock_parallel_adjusted_s"], 1),
+        "mercury_total_s": round(merc_hist[-1]["wallclock_s"], 1),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(args.out, "a") as f:
+        for rec in ref_hist + merc_hist + [summary]:
+            rec.setdefault("model", args.model)
+            rec.setdefault("seed", args.seed)
+            f.write(json.dumps(rec) + "\n")
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

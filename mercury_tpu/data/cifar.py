@@ -99,6 +99,8 @@ def synthetic_cifar(
     test_size: int = 1000,
     image_size: int = 32,
     seed: int = 0,
+    difficulty: str = "uniform",
+    label_noise: float = 0.0,
 ) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
     """Deterministic learnable stand-in for CIFAR when no data is on disk.
 
@@ -106,6 +108,15 @@ def synthetic_cifar(
     class template plus per-sample noise and a random brightness shift, so a
     small CNN can separate classes (used by convergence smoke tests) while
     per-sample difficulty varies (so importance sampling has signal).
+
+    ``difficulty="heavy_tail"`` draws the per-sample noise scale from a
+    lognormal instead of a narrow uniform: most samples are easy, a long
+    tail is very hard — the regime importance sampling is designed for
+    (and where uniform sampling wastes most of its gradient budget on
+    already-learned samples). ``label_noise`` flips that fraction of
+    TRAIN labels to a random other class (test labels stay clean) — the
+    adversarial case for loss-proportional scoring, which chases
+    unlearnable samples.
     """
     rng = np.random.default_rng(seed)
     # Low-frequency class templates: upsampled 4x4 random patterns.
@@ -113,16 +124,36 @@ def synthetic_cifar(
     reps = image_size // 4
     templates = np.repeat(np.repeat(small, reps, axis=1), reps, axis=2)
 
-    def make(n, offset):
+    def make(n, offset, noisy_labels: bool):
         local = np.random.default_rng(seed + offset)
         y = local.integers(0, num_classes, n).astype(np.int32)
-        noise_scale = local.uniform(0.3, 1.5, (n, 1, 1, 1)).astype(np.float32)
+        if difficulty == "heavy_tail":
+            noise_scale = np.clip(
+                local.lognormal(-0.3, 1.0, (n, 1, 1, 1)), 0.1, 8.0
+            ).astype(np.float32)
+        elif difficulty == "uniform":
+            noise_scale = local.uniform(0.3, 1.5, (n, 1, 1, 1)).astype(np.float32)
+        else:
+            raise ValueError(f"unknown difficulty {difficulty!r}")
         noise = local.normal(0, 1, (n, image_size, image_size, 3)).astype(np.float32)
         x = templates[y] + noise_scale * noise
-        x = (x - x.min()) / (x.max() - x.min() + 1e-8)
+        if difficulty == "heavy_tail":
+            # Per-sample normalization: a global min/max would let the
+            # noise tail's extreme values crush every sample's contrast
+            # into a few uint8 levels, making the task unlearnable for
+            # ALL strategies (no discrimination).
+            lo = x.min(axis=(1, 2, 3), keepdims=True)
+            hi = x.max(axis=(1, 2, 3), keepdims=True)
+            x = (x - lo) / (hi - lo + 1e-8)
+        else:
+            x = (x - x.min()) / (x.max() - x.min() + 1e-8)
+        if noisy_labels and label_noise > 0.0:
+            flip = local.random(n) < label_noise
+            shift = local.integers(1, num_classes, n).astype(np.int32)
+            y = np.where(flip, (y + shift) % num_classes, y).astype(np.int32)
         return (x * 255).astype(np.uint8), y
 
-    return make(train_size, 1), make(test_size, 2)
+    return make(train_size, 1, True), make(test_size, 2, False)
 
 
 def synthetic_sequences(
@@ -192,6 +223,24 @@ def load_dataset(
         num_classes = 10
         train, test = synthetic_cifar(
             num_classes, synthetic_train_size, synthetic_test_size, seed=seed
+        )
+        return train, test, {
+            "num_classes": num_classes,
+            "mean": CIFAR10_MEAN,
+            "std": CIFAR10_STD,
+            "synthetic": True,
+        }
+
+    if name == "synthetic_hard":
+        # The sample-efficiency benchmark task: 20 classes, heavy-tailed
+        # per-sample difficulty (lognormal noise scale — a long tail of
+        # hard samples), 5% train-label noise, clean test labels. Built to
+        # DISCRIMINATE sampling strategies: easy tasks saturate before any
+        # strategy differentiates (the round-1 experiment's failure mode).
+        num_classes = 20
+        train, test = synthetic_cifar(
+            num_classes, synthetic_train_size, synthetic_test_size,
+            seed=seed, difficulty="heavy_tail", label_noise=0.05,
         )
         return train, test, {
             "num_classes": num_classes,
