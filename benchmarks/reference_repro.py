@@ -180,6 +180,12 @@ def run_reference_torch(data, shards, model_name, steps, eval_every,
     num_classes = int(y_train.max()) + 1
     spe = steps_per_epoch or max(len(x_train) // batch, 1)
     epochs = max(-(-steps // spe), 1)
+    # The reference anneals over its CONFIGURED horizon (T_max =
+    # num_epochs = 100, ``pytorch_collab.py:27,62``) regardless of where
+    # the run stops — match that so a short measured run sees the same
+    # near-constant LR the reference's first epochs do (run_mercury's
+    # num_epochs mirrors it).
+    t_max = max(epochs, 100)
     for w in range(W):
         torch.manual_seed(seed + w)  # per-worker init, then averaged
         net = torch_model(model_name, num_classes)
@@ -187,7 +193,7 @@ def run_reference_torch(data, shards, model_name, steps, eval_every,
         nets.append(net)
         opt = torch.optim.Adam(net.parameters(), lr=lr)
         opts.append(opt)
-        scheds.append(torch.optim.lr_scheduler.CosineAnnealingLR(opt, epochs))
+        scheds.append(torch.optim.lr_scheduler.CosineAnnealingLR(opt, t_max))
 
     # average_model (:84-87): start from the cross-worker mean.
     with torch.no_grad():
@@ -207,13 +213,15 @@ def run_reference_torch(data, shards, model_name, steps, eval_every,
     def next_pool_idx(w, n):
         s = streams[w]
         out = []
-        while len(out) < n:
+        got = 0
+        while got < n:
             if s["pos"] >= len(s["order"]):
                 s["order"] = s["rng"].permutation(s["order"])
                 s["pos"] = 0
-            take = min(n - len(out), len(s["order"]) - s["pos"])
+            take = min(n - got, len(s["order"]) - s["pos"])
             out.append(s["order"][s["pos"]:s["pos"] + take])
             s["pos"] += take
+            got += take
         return np.concatenate(out)
 
     aug_rng = np.random.default_rng(seed + 77)
@@ -329,7 +337,11 @@ def run_mercury(model_name, steps, eval_every, world_size, seed=0,
     cfg = TrainConfig(
         model=model_name, dataset="synthetic", world_size=world_size,
         batch_size=32, presample_batches=10, noniid=True,
-        dirichlet_alpha=0.5, seed=seed, num_epochs=1000,
+        dirichlet_alpha=0.5, seed=seed,
+        # Cosine horizon matched to the torch arm's T_max=100-epoch
+        # schedule: both arms see a near-constant LR over a short
+        # measured window, as the reference's own first epochs would.
+        num_epochs=100,
         steps_per_epoch=steps_per_epoch, eval_every=0, log_every=0,
         compute_dtype="float32",
         # The reference has NO cross-worker importance-stat exchange and
@@ -338,9 +350,16 @@ def run_mercury(model_name, steps, eval_every, world_size, seed=0,
     )
     tr = Trainer(cfg)
     history = []
+    # First step outside the timer (XLA compile) — same rule as
+    # sample_efficiency.py, so the two benchmarks' seconds are comparable.
+    tr.state, m0 = tr.train_step(
+        tr.state, tr.dataset.x_train, tr.dataset.y_train,
+        tr.dataset.shard_indices,
+    )
+    np.asarray(m0["train/loss"])
     t0 = time.perf_counter()
     last_loss = float("nan")
-    for step in range(1, steps + 1):
+    for step in range(2, steps + 1):
         tr.state, m = tr.train_step(
             tr.state, tr.dataset.x_train, tr.dataset.y_train,
             tr.dataset.shard_indices,

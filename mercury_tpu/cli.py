@@ -98,7 +98,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
           f"steps/epoch: {trainer.steps_per_epoch}")
     if args.dry_run:
         state, metrics = trainer.train_step(
-            trainer.state, trainer.dataset.x_train, trainer.dataset.y_train,
+            trainer.state, trainer._step_x, trainer._step_y,
             trainer.dataset.shard_indices,
         )
         trainer.state = state

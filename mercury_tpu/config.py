@@ -42,6 +42,16 @@ class TrainConfig:
     # world_size × tensor_parallel.
     tensor_parallel: int = 1
     model_axis: str = "model"        # name of the tensor-parallel mesh axis
+    # Train-data placement. "replicated" (default): the full train arrays
+    # are device-resident and every worker gathers its shard rows by
+    # global index — fine for CIFAR, a dead end past it. "sharded": each
+    # worker's shard rows are MATERIALIZED as a [W, L, ...] array sharded
+    # P(data) — per-device train-data memory is 1/W of the shard matrix,
+    # and in multi-controller runs each host transfers only its own
+    # workers' rows (the load_partition_data_distributed_cifar10 pattern,
+    # cifar10/data_loader.py:214-245). Train-split eval gathers from the
+    # host copy.
+    data_placement: str = "replicated"
 
     # Optimization ----------------------------------------------------------
     batch_size: int = 32             # per-worker train batch (exp_dataset.py:11,24)

@@ -219,28 +219,25 @@ def load_dataset(
     normalization stats, and whether data is synthetic.
     """
     name = name.lower()
-    if name == "synthetic":
-        num_classes = 10
-        train, test = synthetic_cifar(
-            num_classes, synthetic_train_size, synthetic_test_size, seed=seed
-        )
-        return train, test, {
-            "num_classes": num_classes,
-            "mean": CIFAR10_MEAN,
-            "std": CIFAR10_STD,
-            "synthetic": True,
-        }
-
-    if name == "synthetic_hard":
-        # The sample-efficiency benchmark task: 20 classes, heavy-tailed
-        # per-sample difficulty (lognormal noise scale — a long tail of
-        # hard samples), 5% train-label noise, clean test labels. Built to
-        # DISCRIMINATE sampling strategies: easy tasks saturate before any
-        # strategy differentiates (the round-1 experiment's failure mode).
-        num_classes = 20
+    # Synthetic variants: (num_classes, difficulty, label_noise).
+    # - synthetic: the easy smoke/CI stand-in;
+    # - synthetic_hard: the sample-efficiency benchmark task — 20 classes,
+    #   heavy-tailed per-sample difficulty (lognormal noise scale: a long
+    #   tail of hard samples), 5% train-label noise, clean test labels;
+    #   built to DISCRIMINATE sampling strategies (easy tasks saturate
+    #   before any strategy differentiates — round 1's failure mode);
+    # - synthetic_tail: boundary probe — same heavy tail, CLEAN labels,
+    #   isolating whether label noise is what erases the IS advantage.
+    _SYNTH = {
+        "synthetic": (10, "uniform", 0.0),
+        "synthetic_tail": (20, "heavy_tail", 0.0),
+        "synthetic_hard": (20, "heavy_tail", 0.05),
+    }
+    if name in _SYNTH:
+        num_classes, difficulty, label_noise = _SYNTH[name]
         train, test = synthetic_cifar(
             num_classes, synthetic_train_size, synthetic_test_size,
-            seed=seed, difficulty="heavy_tail", label_noise=0.05,
+            seed=seed, difficulty=difficulty, label_noise=label_noise,
         )
         return train, test, {
             "num_classes": num_classes,

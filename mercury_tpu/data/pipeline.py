@@ -207,6 +207,7 @@ def make_sharded_dataset(
     std: np.ndarray,
     num_classes: int,
     synthetic: bool = True,
+    device_resident: bool = True,
 ) -> ShardedDataset:
     """Build a :class:`ShardedDataset` from host arrays + partition output.
 
@@ -224,9 +225,15 @@ def make_sharded_dataset(
         rows.append(np.tile(s, reps)[:max_len])
     shard_indices = np.stack(rows).astype(np.int32)
     shard_sizes = np.array([len(s) for s in shards], np.int32)
+    # device_resident=False (data_placement="sharded"): the full train
+    # arrays stay host-side — the step consumes materialized per-worker
+    # shard arrays instead, and eval gathers from the host copy.
+    conv_x = jnp.asarray if device_resident else np.asarray
+    conv_y = ((lambda a: jnp.asarray(a, jnp.int32)) if device_resident
+              else (lambda a: np.asarray(a, np.int32)))
     return ShardedDataset(
-        x_train=jnp.asarray(x_train),
-        y_train=jnp.asarray(y_train, jnp.int32),
+        x_train=conv_x(x_train),
+        y_train=conv_y(y_train),
         x_test=jnp.asarray(x_test),
         y_test=jnp.asarray(y_test, jnp.int32),
         shard_indices=jnp.asarray(shard_indices),

@@ -121,21 +121,60 @@ def globalize_state(state, mesh: Mesh, axis_name: str = "data",
     )
 
 
-def globalize_dataset(dataset, mesh: Mesh, axis_name: str = "data"):
+def globalize_dataset(dataset, mesh: Mesh, axis_name: str = "data",
+                      include_train_arrays: bool = True):
     """Re-place a ``ShardedDataset``'s train-step inputs as global arrays:
     the full train arrays replicated, the ``[W, L]`` shard-index matrix
     sharded along ``axis_name`` (each host only stores its workers' rows
     on its devices — the SPMD analogue of
-    ``load_partition_data_distributed_cifar10``)."""
-    return dataclasses.replace(
-        dataset,
-        x_train=make_global_array(dataset.x_train, mesh, P()),
-        y_train=make_global_array(dataset.y_train, mesh, P()),
+    ``load_partition_data_distributed_cifar10``).
+
+    ``include_train_arrays=False`` (the ``data_placement="sharded"`` path)
+    leaves x_train/y_train as host arrays — the step consumes the
+    materialized per-worker arrays from :func:`worker_shard_global_arrays`
+    instead, and eval reads the host copy."""
+    replaced = dict(
         shard_indices=make_global_array(dataset.shard_indices, mesh,
                                         P(axis_name)),
         shard_sizes=make_global_array(dataset.shard_sizes, mesh,
                                       P(axis_name)),
     )
+    if include_train_arrays:
+        replaced.update(
+            x_train=make_global_array(dataset.x_train, mesh, P()),
+            y_train=make_global_array(dataset.y_train, mesh, P()),
+        )
+    return dataclasses.replace(dataset, **replaced)
+
+
+def worker_shard_global_arrays(
+    dataset, mesh: Mesh, axis_name: str = "data"
+) -> Tuple[jax.Array, jax.Array]:
+    """Materialize the per-worker train data as ``[W, L, ...]`` global
+    arrays sharded ``P(axis_name)`` — each host constructs and transfers
+    ONLY the rows its devices own (``host_worker_slice``), so no device
+    and no host→device path ever carries the full dataset. This is the
+    scaling-past-CIFAR data path (``data_placement="sharded"``),
+    capability parity with ``load_partition_data_distributed_cifar10``
+    (``cifar10/data_loader.py:214-245``)."""
+    sidx = np.asarray(dataset.shard_indices)
+    xs = np.asarray(dataset.x_train)
+    ys = np.asarray(dataset.y_train)
+    W, L = sidx.shape
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def build(values, shape_tail, dtype):
+        def cb(idx):
+            rows = range(*idx[0].indices(W))
+            block = np.stack([values[sidx[w]] for w in rows])
+            return block[(slice(None),) + tuple(idx[1:])]
+
+        return jax.make_array_from_callback(
+            (W, L) + shape_tail, sharding, cb
+        )
+
+    return (build(xs, xs.shape[1:], xs.dtype),
+            build(ys, (), ys.dtype))
 
 
 def host_worker_slice(mesh: Mesh, axis_name: str = "data") -> np.ndarray:

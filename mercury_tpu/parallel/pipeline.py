@@ -16,6 +16,23 @@ runs as an SPMD schedule inside ``shard_map``:
   exact reverse for backprop — is one compiled program, differentiated by
   JAX AD through the ``ppermute``s.
 
+Schedule/memory trade-off (vs 1F1B): under JAX AD the backward replays the
+tick scan in reverse, so forward+backward both take ``M + S - 1`` ticks —
+the same total as 1F1B at equal ``M``. 1F1B's real edge is activation
+memory (≤ S in-flight microbatches instead of all M); here the idiomatic
+XLA answer is ``remat=True``, which re-materializes each tick's stage
+compute in the backward, dropping the stash to the scan carries and per-
+tick inputs — 1F1B-class memory at GPipe simplicity. The bubble fraction
+``(S-1)/(M+S-1)`` is then amortized by raising ``M``, which remat makes
+cheap.
+
+Composition: sequence parallelism (``sp_axis`` — a 2-D ``pipe × seq``
+mesh, each stage running ring/Ulysses attention over its sequence shard)
+and dense-path MoE blocks (router aux losses accumulated through the
+staged scan and psummed out) both compose; expert-parallel MoE
+(``moe_ep_axis``) does not (the all-to-all would need an expert axis in
+the same shard_map).
+
 Embedding/positional/head params stay replicated: their compute is cheap
 and position-local, so only the block stack is staged. Correct gradient
 scaling under ``shard_map``'s automatic replicated-cotangent ``psum`` is
@@ -64,24 +81,44 @@ def make_pp_apply(
     mesh: Mesh,
     num_microbatches: int,
     axis: str = "pipe",
+    remat: bool = False,
+    with_aux: bool = False,
 ):
     """Build a jitted pipeline-parallel forward for ``model`` (a
-    :class:`~mercury_tpu.models.TransformerClassifier` **without**
-    ``sp_axis``).
+    :class:`~mercury_tpu.models.TransformerClassifier`).
 
-    Returns ``apply(stacked_blocks, rest_params, x) → logits`` where
-    ``stacked_blocks`` is sharded ``P(axis)`` on its leading layer axis,
-    ``rest_params`` is replicated, and ``x: [B, T, F]`` is replicated
-    (``num_microbatches`` must divide ``B``). Output logits are replicated.
-    Differentiable end to end.
+    Returns ``apply(stacked_blocks, rest_params, x) → logits`` (or
+    ``(logits, aux)`` with ``with_aux=True``, where ``aux`` is the summed
+    MoE router load-balancing loss) with ``stacked_blocks`` sharded
+    ``P(axis)`` on its leading layer axis, ``rest_params`` replicated, and
+    ``x: [B, T, F]`` replicated over the pipe axis (``num_microbatches``
+    must divide ``B``). With ``model.sp_axis`` set, ``mesh`` must carry
+    that axis too and ``x``'s sequence dimension arrives sharded over it
+    (``P(None, sp_axis)``). Output logits are replicated. Differentiable
+    end to end.
+
+    ``remat=True`` re-materializes each tick's stage compute in the
+    backward (``jax.checkpoint``) — activation stash drops from all
+    ``M`` microbatches to the scan carries, the 1F1B-class memory
+    footprint (see module docstring).
     """
-    if model.sp_axis is not None:
-        raise ValueError("pipeline parallelism requires sp_axis=None")
-    if model.moe_experts is not None:
+    sp = model.sp_axis
+    if sp is not None and sp not in mesh.axis_names:
         raise ValueError(
-            "pipeline parallelism does not support MoE blocks (the sowed "
-            "aux loss does not carry through the staged scan)"
+            f"model.sp_axis={sp!r} needs that axis in the mesh; "
+            f"mesh axes: {mesh.axis_names}"
         )
+    if model.moe_experts is not None:
+        if model.moe_ep_axis is not None:
+            raise ValueError(
+                "pipeline parallelism composes with dense-path MoE only "
+                "(moe_ep_axis's all-to-all would need an expert mesh axis)"
+            )
+        if not with_aux:
+            raise ValueError(
+                "MoE blocks sow a router aux loss: call with with_aux=True "
+                "and add it to the training loss"
+            )
     num_layers = model.num_layers
     stages = mesh.shape[axis]
     if num_layers % stages:
@@ -92,10 +129,11 @@ def make_pp_apply(
 
     # Single-block applier reused for every staged layer — built by the
     # model's own factory so block config can never drift.
-    block = model.make_block(sp_axis=None)
+    block = model.make_block()
 
     # Embedding/head run as the model's OWN methods on the non-block params,
-    # so the pipelined forward is definitionally the dense forward.
+    # so the pipelined forward is definitionally the dense forward (they
+    # handle sp_axis internally: global positions / pooled pmean).
     def embed(rest, x):
         return model.apply({"params": rest}, x, method="embed")
 
@@ -111,56 +149,87 @@ def make_pp_apply(
 
         h_mb = embed(rest, x).reshape(m, mb, t_len, model.d_model)
 
-        def apply_stage(h):
-            def body(carry, p):
-                return block.apply({"params": p}, carry), None
-
-            out, _ = lax.scan(body, h, stacked_local)
-            return out
-
-        perm = [(i, (i + 1) % s) for i in range(s)]
         # pcast: the carries become device-varying after one tick, so their
         # initial values must be typed as varying over the pipe axis too.
+        varying_axes = (axis,) if sp is None else (axis, sp)
+
+        def apply_stage(h):
+            def body(carry, p):
+                h_in, aux = carry
+                out, mut = block.apply({"params": p}, h_in,
+                                       mutable=["losses"])
+                from mercury_tpu.utils.tree import sum_sowed_losses
+
+                return (out, aux + sum_sowed_losses(mut)), None
+
+            # The aux carry must match the block output's device-varying
+            # type over the manual axes.
+            aux_init = lax.pcast(jnp.zeros(()), varying_axes, to="varying")
+            (out, aux), _ = lax.scan(body, (h, aux_init), stacked_local)
+            return out, aux
+
+        if remat:
+            apply_stage = jax.checkpoint(apply_stage)
+
+        perm = [(i, (i + 1) % s) for i in range(s)]
         zeros = lax.pcast(
-            jnp.zeros((mb, t_len, model.d_model), h_mb.dtype), (axis,),
+            jnp.zeros((mb, t_len, model.d_model), h_mb.dtype), varying_axes,
             to="varying",
         )
         buf0 = lax.pcast(
-            jnp.zeros((m, mb, t_len, model.d_model), h_mb.dtype), (axis,),
-            to="varying",
+            jnp.zeros((m, mb, t_len, model.d_model), h_mb.dtype),
+            varying_axes, to="varying",
         )
+        aux0 = lax.pcast(jnp.zeros(()), varying_axes, to="varying")
 
         def tick(carry, t):
-            prev_out, buf = carry
+            prev_out, buf, aux = carry
             recv = lax.ppermute(prev_out, axis, perm)
             x_in = jnp.where(idx == 0, h_mb[jnp.clip(t, 0, m - 1)], recv)
-            y = apply_stage(x_in)
+            y, aux_t = apply_stage(x_in)
             out_idx = t - (s - 1)
             slot = jnp.clip(out_idx, 0, m - 1)
             keep = (idx == s - 1) & (out_idx >= 0)
             buf = buf.at[slot].set(jnp.where(keep, y, buf[slot]))
-            return (y, buf), None
+            # Only ticks that carried a real microbatch through this stage
+            # contribute router aux: stage idx processes microbatch t-idx,
+            # valid while 0 <= t-idx < m.
+            mb_idx = t - idx
+            valid = (mb_idx >= 0) & (mb_idx < m)
+            aux = aux + jnp.where(valid, aux_t, 0.0)
+            return (y, buf, aux), None
 
-        (_, buf), _ = lax.scan(tick, (zeros, buf0), jnp.arange(m + s - 1))
+        (_, buf, aux), _ = lax.scan(
+            tick, (zeros, buf0, aux0), jnp.arange(m + s - 1)
+        )
         # Broadcast the last stage's results (zeros elsewhere).
         h_out = lax.psum(jnp.where(idx == s - 1, buf, jnp.zeros_like(buf)), axis)
         logits = head(rest, h_out.reshape(bsz, t_len, model.d_model))
 
-        # Gradient scaling: `rest` is replicated and its forward compute is
-        # executed identically on all S devices, so shard_map AD's automatic
-        # cotangent psum would return S× its true gradient; pre-dividing the
-        # (replicated) logits' contribution via pmean keeps every param's
-        # gradient exact — stacked block params are sharded (no auto-psum)
-        # and their cotangents flow through the psum above, which transposes
-        # to an identity broadcast, leaving them unscaled. Pinned by
-        # tests/test_pipeline_parallel.py.
-        return lax.pmean(logits, axis)
+        # Gradient scaling: `rest` is replicated over the pipe axis and its
+        # forward compute is executed identically on all S devices, so
+        # shard_map AD's automatic cotangent psum would return S× its true
+        # gradient; pre-dividing the (replicated) logits' contribution via
+        # pmean keeps every param's gradient exact — stacked block params
+        # are sharded (no auto-psum) and their cotangents flow through the
+        # psum above, which transposes to an identity broadcast, leaving
+        # them unscaled. Pinned by tests/test_pipeline_parallel.py.
+        logits = lax.pmean(logits, axis)
+        if not with_aux:
+            return logits
+        # Router aux: summed over stages (psum) and normalized per
+        # microbatch; each block's aux is a mean over its own tokens.
+        aux_total = lax.psum(aux, axis) / m
+        if sp is not None:
+            aux_total = lax.pmean(aux_total, sp)
+        return logits, aux_total
 
+    x_spec = P() if sp is None else P(None, sp)
     sharded = shard_map(
         local_apply,
         mesh=mesh,
-        in_specs=(P(axis), P(), P()),
-        out_specs=P(),
+        in_specs=(P(axis), P(), x_spec),
+        out_specs=P() if not with_aux else (P(), P()),
     )
     return jax.jit(sharded)
 

@@ -123,7 +123,7 @@ def timing_breakdown(trainer, iters: int = 10) -> Dict[str, float]:
 
     def fused():
         state, metrics = trainer.train_step(
-            trainer.state, ds.x_train, ds.y_train, ds.shard_indices
+            trainer.state, trainer._step_x, trainer._step_y, ds.shard_indices
         )
         trainer.state = state
         return metrics["train/loss"]

@@ -172,6 +172,14 @@ def make_train_step(
         raise ValueError(
             f"unknown importance_score {config.importance_score!r}"
         )
+    if config.data_placement not in ("replicated", "sharded"):
+        raise ValueError(
+            f"unknown data_placement {config.data_placement!r}"
+        )
+    # "sharded": x_train/y_train arrive as [W, L, ...] per-worker shard
+    # rows sharded P(axis) — each device holds only its own worker's
+    # samples, and gathers are shard-local (slots index the row directly).
+    data_sharded = config.data_placement == "sharded"
 
     def _loss_per_sample(logits, labels):
         if use_pallas:
@@ -259,6 +267,16 @@ def make_train_step(
 
     def body(state: MercuryState, x_train, y_train, shard_indices):
         # Leading axis inside shard_map is this device's single worker row.
+        if data_sharded:
+            x_loc, y_loc = x_train[0], y_train[0]
+
+            def gather_train(slots):
+                return x_loc[slots], y_loc[slots]
+        else:
+            def gather_train(slots):
+                gidx = shard_indices[0][slots]
+                return x_train[gidx], y_train[gidx]
+
         rng = state.rng[0]
         (k_stream, k_aug, k_sel, k_aug2, k_boot_stream, k_boot_aug,
          k_boot_sel, k_next) = jax.random.split(rng, 8)
@@ -281,9 +299,8 @@ def make_train_step(
             # (pytorch_collab.py:158-164). --------------------------------
             def score_next(stream, ema, ks, ka, ksel):
                 stream, slots = next_pool(stream, ks, pool_size)
-                gidx = shard_indices[0][slots]
-                imgs = _augment(ka, normalize_images(x_train[gidx], mean, std))
-                labs = y_train[gidx]
+                raw, labs = gather_train(slots)
+                imgs = _augment(ka, normalize_images(raw, mean, std))
                 pool_logits, _, _ = _apply_train(
                     state.params, state.batch_stats, imgs, False
                 )
@@ -333,9 +350,8 @@ def make_train_step(
                 # Shuffled wrapping presample stream (≡ Trainer.get_next over
                 # the presampling loader, :74-82).
                 stream, slots = next_pool(stream, k_stream, pool_size)
-            global_idx = shard_indices[0][slots]
-            images = _augment(k_aug, normalize_images(x_train[global_idx], mean, std))
-            labels = y_train[global_idx]
+            raw, labels = gather_train(slots)
+            images = _augment(k_aug, normalize_images(raw, mean, std))
 
             if use_is:
                 # --- importance scoring: ONE batched inference forward over
@@ -353,11 +369,10 @@ def make_train_step(
                     # the reference's does via get_slice, util.py:123).
                     groupwise = update_importance(groupwise, slots, pool_losses)
                     sel_slots, scaled_probs = gw_draw(groupwise, k_sel, batch_size)
-                    sel_global = shard_indices[0][sel_slots]
+                    sel_raw, sel_labels = gather_train(sel_slots)
                     sel_images = _augment(
-                        k_aug2, normalize_images(x_train[sel_global], mean, std)
+                        k_aug2, normalize_images(sel_raw, mean, std)
                     )
-                    sel_labels = y_train[sel_global]
                     score_avg = pool_mean(pool_losses, stat_axis)
                     ema = ema_update(ema, score_avg, config.ema_alpha)
                     avg_pool_loss = _pool_loss_metric(
@@ -513,10 +528,11 @@ def make_train_step(
     if auto_axes:
         # Manual over the data axis only; GSPMD handles the rest.
         smap_kw["axis_names"] = frozenset({axis})
+    data_spec = P(axis) if data_sharded else P()
     sharded = shard_map(
         fn,
         mesh=mesh,
-        in_specs=(specs, P(), P(), P(axis)),
+        in_specs=(specs, data_spec, data_spec, P(axis)),
         out_specs=(specs, P()),
         check_vma=False,
         **smap_kw,

@@ -74,6 +74,7 @@ def build_dataset(config: TrainConfig, seed_offset: int = 0) -> ShardedDataset:
     return make_sharded_dataset(
         train, test, shards, info["mean"], info["std"], info["num_classes"],
         synthetic=info.get("synthetic", True),
+        device_resident=config.data_placement != "sharded",
     )
 
 
@@ -249,6 +250,22 @@ class Trainer:
         # dataset are process-local; re-place them as global arrays over the
         # (cross-process) mesh. Single-process runs skip this — shard_map
         # handles placement there.
+        # Step-input train arrays. "sharded": materialize each worker's
+        # shard rows as [W, L, ...] arrays sharded over the data axis —
+        # per-device memory is one shard row, and in multi-controller runs
+        # each host transfers only its own workers' rows; the dataset's
+        # x_train/y_train stay host-side for eval. Built BEFORE the
+        # dataset is globalized (it reads the process-local host copy,
+        # identical on every process by seeded construction).
+        data_sharded = config.data_placement == "sharded"
+        if data_sharded:
+            from mercury_tpu.parallel.distributed import (
+                worker_shard_global_arrays,
+            )
+
+            self._step_x, self._step_y = worker_shard_global_arrays(
+                self.dataset, self.mesh, config.mesh_axis
+            )
         if jax.process_count() > 1:
             from mercury_tpu.parallel.distributed import (
                 globalize_dataset,
@@ -258,8 +275,12 @@ class Trainer:
             self.state = globalize_state(self.state, self.mesh, config.mesh_axis,
                                          zero_sharding=config.zero_sharding)
             self.dataset = globalize_dataset(
-                self.dataset, self.mesh, config.mesh_axis
+                self.dataset, self.mesh, config.mesh_axis,
+                include_train_arrays=not data_sharded,
             )
+        if not data_sharded:
+            self._step_x = self.dataset.x_train
+            self._step_y = self.dataset.y_train
         self.train_step = make_train_step(
             self.model, self.tx, config, self.mesh, self.dataset.mean,
             self.dataset.std, state_out_shardings=self._state_out_shardings,
@@ -359,16 +380,16 @@ class Trainer:
                     k = self.scan_steps
                     self.state, metrics = self.train_step_many(
                         self.state,
-                        self.dataset.x_train,
-                        self.dataset.y_train,
+                        self._step_x,
+                        self._step_y,
                         self.dataset.shard_indices,
                     )
                 else:
                     k = 1
                     self.state, metrics = self.train_step(
                         self.state,
-                        self.dataset.x_train,
-                        self.dataset.y_train,
+                        self._step_x,
+                        self._step_y,
                         self.dataset.shard_indices,
                     )
                 step += k
@@ -441,8 +462,13 @@ class Trainer:
             ])                                                       # [nb, B]
             # Multi-controller: keep eval inputs as host arrays — jit treats
             # them as replicated, compatible with the global params. (A
-            # committed process-local device array would conflict.)
-            conv = np.asarray if jax.process_count() > 1 else jnp.asarray
+            # committed process-local device array would conflict.) Same
+            # for sharded data placement: eval reads the host copy rather
+            # than committing a device-replicated full split.
+            conv = (np.asarray
+                    if jax.process_count() > 1
+                    or self.config.data_placement == "sharded"
+                    else jnp.asarray)
             self._eval_cache[train] = (
                 conv(np.asarray(x)[idx]),
                 conv(np.asarray(y)[idx]),

@@ -131,9 +131,29 @@ def main(port: str, pid: int) -> None:
     zloss = float(mz["train/loss"])
     assert np.isfinite(zloss), zloss
 
+    # 7. Sharded data placement, multi-controller: each host materializes
+    #    and transfers ONLY its own workers' shard rows — this process's
+    #    addressable train-step data must be well under the full dataset —
+    #    and the loss must equal the replicated-placement run bit-for-bit
+    #    (same bytes, same program).
+    trainer_s = Trainer(cfg.replace(data_placement="sharded"), mesh=mesh)
+    local_bytes = sum(s.data.nbytes
+                      for s in trainer_s._step_x.addressable_shards)
+    full_bytes = np.asarray(trainer_s.dataset.x_train).nbytes
+    assert local_bytes < 0.75 * full_bytes, (local_bytes, full_bytes)
+    sl = None
+    for _ in range(2):
+        trainer_s.state, ms = trainer_s.train_step(
+            trainer_s.state, trainer_s._step_x, trainer_s._step_y,
+            trainer_s.dataset.shard_indices,
+        )
+        sl = float(ms["train/loss"])
+    assert sl == losses[-1], (sl, losses[-1])
+
     # Full precision (hex) so the cross-process comparison is bit-for-bit.
     print(f"OK {psum_val} {pmean_val} {mine.tolist()} "
-          f"loss={losses[-1].hex()} post={post.hex()} zero={zloss.hex()}",
+          f"loss={losses[-1].hex()} post={post.hex()} zero={zloss.hex()} "
+          f"sharded={sl.hex()} sharded_frac={local_bytes/full_bytes:.3f}",
           flush=True)
 
 

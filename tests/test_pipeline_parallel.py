@@ -110,3 +110,117 @@ class TestTraining:
         # Stage sharding survives the optimizer update.
         leaf = jax.tree_util.tree_leaves(st)[0]
         assert leaf.addressable_shards[0].data.shape[0] == L // 4
+
+
+class TestRematAndCompositions:
+    def test_remat_matches_non_remat(self, setup):
+        """remat=True re-materializes stage compute in the backward —
+        identical forward AND gradients, smaller stash."""
+        model, x, y, params, mesh, stacked, rest = setup
+
+        def loss(apply):
+            def f(stacked, rest):
+                logits = apply(stacked, rest, x)
+                return jnp.mean(per_sample_loss(logits, y))
+
+            return jax.jit(jax.value_and_grad(f, argnums=(0, 1)))
+
+        plain = make_pp_apply(model, mesh, num_microbatches=2)
+        remat = make_pp_apply(model, mesh, num_microbatches=2, remat=True)
+        l0, g0 = loss(plain)(stacked, rest)
+        l1, g1 = loss(remat)(stacked, rest)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_moe_dense_aux_through_pipeline(self):
+        """Dense-path MoE blocks compose: the router aux accumulated
+        through the staged scan equals the dense model's sown aux."""
+        from mercury_tpu.utils.tree import sum_sowed_losses
+
+        moe = TransformerClassifier(num_classes=C, d_model=D, num_heads=2,
+                                    num_layers=L, max_len=T, moe_experts=2,
+                                    moe_capacity_factor=8.0)
+        x = jax.random.normal(jax.random.key(5), (8, T, F), jnp.float32)
+        params = moe.init(jax.random.key(6), x, train=False)["params"]
+        logits_d, mut = moe.apply({"params": params}, x, train=True,
+                                  mutable=["losses"])
+        # The Switch load-balance loss is nonlinear in batch composition,
+        # so the pipelined (per-microbatch) aux equals the MEAN of the
+        # dense aux over the same microbatch splits — not the full-batch
+        # aux. That per-microbatch semantic is inherent to pipelining.
+        aux_mb = []
+        for mb in (x[:4], x[4:]):
+            _, mut_mb = moe.apply({"params": params}, mb, train=True,
+                                  mutable=["losses"])
+            aux_mb.append(float(sum_sowed_losses(mut_mb)))
+        aux_d = np.mean(aux_mb)
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        stacked, rest = stack_block_params(params, L)
+        stacked = shard_stacked_blocks(stacked, mesh)
+        pp = make_pp_apply(moe, mesh, num_microbatches=2, with_aux=True)
+        logits_p, aux_p = pp(stacked, rest, x)
+        np.testing.assert_allclose(np.asarray(logits_p),
+                                   np.asarray(logits_d),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(float(aux_p), float(aux_d), rtol=1e-5)
+
+    def test_pp_sp_2d_mesh_matches_dense(self):
+        """pipe × seq mesh: each stage runs ring attention over its
+        sequence shard; forward and gradients match the dense model."""
+        sp_model = TransformerClassifier(num_classes=C, d_model=D,
+                                         num_heads=2, num_layers=L,
+                                         max_len=T, sp_axis="seq")
+        dense = TransformerClassifier(num_classes=C, d_model=D, num_heads=2,
+                                      num_layers=L, max_len=T)
+        x = jax.random.normal(jax.random.key(7), (4, T, F), jnp.float32)
+        y = jnp.arange(4) % C
+        params = dense.init(jax.random.key(8), x, train=False)["params"]
+
+        def dense_loss(params):
+            logits = dense.apply({"params": params}, x, train=False)
+            return jnp.mean(per_sample_loss(logits, y))
+
+        l_ref, g_ref = jax.value_and_grad(dense_loss)(params)
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("pipe", "seq"))
+        stacked, rest = stack_block_params(params, L)
+        stacked = jax.device_put(
+            stacked, jax.NamedSharding(mesh, jax.sharding.PartitionSpec("pipe"))
+        )
+        pp = make_pp_apply(sp_model, mesh, num_microbatches=2)
+
+        def pp_loss(stacked, rest):
+            logits = pp(stacked, rest, x)
+            return jnp.mean(per_sample_loss(logits, y))
+
+        l_pp, (g_st, g_rest) = jax.jit(
+            jax.value_and_grad(pp_loss, argnums=(0, 1))
+        )(stacked, rest)
+        np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+        g_pp = unstack_block_params(g_st, g_rest)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=1e-5)
+
+    def test_ep_moe_rejected(self):
+        moe_ep = TransformerClassifier(num_classes=C, d_model=D, num_heads=2,
+                                       num_layers=L, max_len=T, moe_experts=2,
+                                       moe_ep_axis="expert",
+                                       moe_capacity_factor=8.0)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        with pytest.raises(ValueError, match="dense-path MoE"):
+            make_pp_apply(moe_ep, mesh, num_microbatches=2, with_aux=True)
+
+    def test_moe_requires_with_aux(self):
+        moe = TransformerClassifier(num_classes=C, d_model=D, num_heads=2,
+                                    num_layers=L, max_len=T, moe_experts=2,
+                                    moe_capacity_factor=8.0)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        with pytest.raises(ValueError, match="with_aux"):
+            make_pp_apply(moe, mesh, num_microbatches=2)
