@@ -37,7 +37,8 @@ class TrainConfig:
     # transformer block (parallel/tensor.py). The Mercury IS step runs
     # manual-SPMD over the data axis and leaves the model axis to GSPMD,
     # so scoring forward, draw, reweighted backward, and the stat psum all
-    # execute TP-sharded. Requires model="transformer" and
+    # execute TP-sharded. Requires the transformer family
+    # (model="transformer" | "vit") and
     # num_heads % tensor_parallel == 0; total devices =
     # world_size × tensor_parallel.
     tensor_parallel: int = 1
@@ -160,14 +161,14 @@ class TrainConfig:
     auto_resume: bool = False
     data_dir: Optional[str] = None   # where CIFAR binaries live; None → search
 
-    # Mixture-of-experts (model="transformer" only): number of Switch
+    # Mixture-of-experts (transformer family only): number of Switch
     # experts per block's MLP; None = dense MLP. The router's
     # load-balancing aux loss enters the training objective scaled by
     # moe_aux_weight (Switch paper's α).
     moe_experts: Optional[int] = None
     moe_aux_weight: float = 0.01
 
-    # Activation rematerialization (model="transformer" only): recompute
+    # Activation rematerialization (transformer family only): recompute
     # block activations in the backward pass (jax.checkpoint) — ~1 extra
     # forward of FLOPs for O(layers) less activation memory.
     remat: bool = False

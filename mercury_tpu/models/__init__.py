@@ -53,7 +53,7 @@ def create_model(
     """Build a model by name.
 
     Names: ``resnet18/34/50/101/152``, ``vgg11/13/16/19``, ``mobilenetv2``,
-    ``bilstm_attention``, ``transformer``. ``bn_axis_name`` enables
+    ``bilstm_attention``, ``transformer``, ``vit``. ``bn_axis_name`` enables
     cross-replica synced BatchNorm over the given mesh axis (ignored by
     models without BN).
     """
@@ -80,7 +80,17 @@ def create_model(
     if name in ("bilstm_attention", "mylstm", "lstm"):
         return BiLSTMAttention(num_classes=num_classes, compute_dtype=cd,
                                param_dtype=pd, **kwargs)
-    if name == "transformer":
+    if name in ("transformer", "vit"):
+        if name == "vit":
+            # Vision transformer for the CIFAR-shaped datasets: patchified
+            # image input through the SAME TransformerClassifier stack, so
+            # Megatron TP shardings, pipeline staging, and MoE blocks
+            # apply to image training unchanged. max_len defaults to the
+            # 32×32 token count for the chosen patch size — pass max_len
+            # explicitly for other image sizes.
+            kwargs.setdefault("patch_size", 4)
+            kwargs.setdefault("num_layers", 4)
+            kwargs.setdefault("max_len", (32 // kwargs["patch_size"]) ** 2)
         return TransformerClassifier(num_classes=num_classes, compute_dtype=cd,
                                      param_dtype=pd, **kwargs)
     raise ValueError(f"unknown model {name!r}")

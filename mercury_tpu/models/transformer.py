@@ -113,6 +113,12 @@ class TransformerClassifier(nn.Module):
     mlp_ratio: int = 4
     max_len: int = 2048
     causal: bool = False
+    # Vision mode (ViT): with patch_size set, 4-D image input
+    # [B, H, W, C] is patchified to a [B, (H/p)·(W/p), p²·C] token
+    # sequence before the shared embed — so the WHOLE transformer stack
+    # (and its tensor-/pipeline-parallel machinery, which shards the
+    # blocks) applies unchanged to the image datasets.
+    patch_size: Optional[int] = None
     sp_axis: Optional[str] = None
     sp_impl: str = "ring"
     moe_experts: Optional[int] = None
@@ -171,8 +177,28 @@ class TransformerClassifier(nn.Module):
                                   param_dtype=self.param_dtype, name="head")
 
     def embed(self, x):
-        """Input projection + (globally offset) positional embedding."""
+        """Input projection + (globally offset) positional embedding.
+        4-D image input is patchified first (``patch_size``)."""
         x = x.astype(self.compute_dtype)
+        if x.ndim == 4:
+            if self.patch_size is None:
+                raise ValueError(
+                    "4-D (image) input needs patch_size set (ViT mode)"
+                )
+            if self.sp_axis is not None:
+                raise ValueError(
+                    "sequence parallelism over raw images is unsupported: "
+                    "patchify first, then shard the token sequence"
+                )
+            p = self.patch_size
+            b, h, w, c = x.shape
+            if h % p or w % p:
+                raise ValueError(
+                    f"image size {h}x{w} not divisible by patch_size {p}"
+                )
+            x = x.reshape(b, h // p, p, w // p, p, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5)
+            x = x.reshape(b, (h // p) * (w // p), p * p * c)
         _, t, _ = x.shape
         x = self.embed_proj(x)
         if self.sp_axis is None:

@@ -91,7 +91,9 @@ def make_pp_apply(
     ``(logits, aux)`` with ``with_aux=True``, where ``aux`` is the summed
     MoE router load-balancing loss) with ``stacked_blocks`` sharded
     ``P(axis)`` on its leading layer axis, ``rest_params`` replicated, and
-    ``x: [B, T, F]`` replicated over the pipe axis (``num_microbatches``
+    ``x: [B, T, F]`` (or a 4-D image batch when the model has
+    ``patch_size`` set — ViT mode) replicated over the pipe axis
+    (``num_microbatches``
     must divide ``B``). With ``model.sp_axis`` set, ``mesh`` must carry
     that axis too and ``x``'s sequence dimension arrives sharded over it
     (``P(None, sp_axis)``). Output logits are replicated. Differentiable
@@ -143,11 +145,14 @@ def make_pp_apply(
     def local_apply(stacked_local, rest, x):
         s = lax.axis_size(axis)
         idx = lax.axis_index(axis)
-        bsz, t_len, _ = x.shape
+        # Token count comes from the EMBEDDED sequence — raw x may be a
+        # 4-D image batch that embed patchifies (ViT mode).
+        h = embed(rest, x)
+        bsz, t_len, _ = h.shape
         assert bsz % m == 0, "batch must divide into microbatches"
         mb = bsz // m
 
-        h_mb = embed(rest, x).reshape(m, mb, t_len, model.d_model)
+        h_mb = h.reshape(m, mb, t_len, model.d_model)
 
         # pcast: the carries become device-varying after one tick, so their
         # initial values must be typed as varying over the pipe axis too.

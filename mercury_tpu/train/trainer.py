@@ -103,10 +103,10 @@ class Trainer:
                 f"world_size {config.world_size}"
             )
         if tp > 1:
-            if config.model != "transformer":
+            if config.model not in ("transformer", "vit"):
                 raise ValueError(
-                    f"tensor_parallel requires model='transformer', got "
-                    f"{config.model!r}"
+                    "tensor_parallel requires the transformer family "
+                    f"(model='transformer'|'vit'), got {config.model!r}"
                 )
             if jax.process_count() > 1:
                 raise ValueError(
@@ -134,16 +134,17 @@ class Trainer:
         bn_axis = config.mesh_axis if config.batch_norm == "sync" else None
         model_kw = {}
         if config.moe_experts is not None:
-            if config.model != "transformer":
+            if config.model not in ("transformer", "vit"):
                 raise ValueError(
-                    "moe_experts requires model='transformer', got "
-                    f"{config.model!r}"
+                    "moe_experts requires the transformer family "
+                    f"(model='transformer'|'vit'), got {config.model!r}"
                 )
             model_kw["moe_experts"] = config.moe_experts
         if config.remat:
-            if config.model != "transformer":
+            if config.model not in ("transformer", "vit"):
                 raise ValueError(
-                    f"remat requires model='transformer', got {config.model!r}"
+                    "remat requires the transformer family "
+                    f"(model='transformer'|'vit'), got {config.model!r}"
                 )
             model_kw["remat"] = True
         self.model = create_model(
