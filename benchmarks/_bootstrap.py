@@ -15,8 +15,6 @@ sys.path.insert(
     0, os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 )
 
-if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+from mercury_tpu.platform import select_cpu_if_requested  # noqa: E402
 
-    jax.config.update("jax_platforms", "cpu")
+select_cpu_if_requested()

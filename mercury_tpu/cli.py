@@ -86,6 +86,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(json.dumps(dataclasses.asdict(config), indent=2, default=str))
         return 0
 
+    # A virtual-CPU-device request (the CI/dev recipe) must win over any
+    # site-installed accelerator plugin that pins another platform at
+    # interpreter start — selecting CPU is only possible before the first
+    # backend touch, so do it here, first thing.
+    from mercury_tpu.platform import select_cpu_if_requested
+
+    select_cpu_if_requested()
+
     if args.distributed:
         from mercury_tpu.parallel.distributed import initialize
 

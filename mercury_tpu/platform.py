@@ -1,0 +1,34 @@
+"""Platform selection helper.
+
+Some images install an accelerator PJRT plugin whose sitecustomize pins
+``JAX_PLATFORMS`` to a (possibly tunneled, possibly down) backend at
+interpreter start. The project-wide convention is that a
+``--xla_force_host_platform_device_count`` request in ``XLA_FLAGS`` — the
+CI / dev / virtual-mesh recipe — means "run on host CPU": honoring it
+requires BOTH the env var (so spawned child processes inherit the pin)
+and ``jax.config`` (the env alone loses to the sitecustomize), and it
+must happen before the first backend touch (afterwards the update is a
+silent no-op).
+
+One shared implementation for every entry point (CLI, driver hooks,
+benchmark/example bootstraps, test harness) so the recipe cannot drift.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def select_cpu_if_requested() -> bool:
+    """Pin the CPU platform iff ``XLA_FLAGS`` carries the virtual-host-
+    device flag. Returns whether the pin was applied. Call before any
+    ``jax.devices()`` / first computation."""
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        return False
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True

@@ -20,13 +20,15 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=4"
 ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
 # Keep the remote-TPU plugin (sitecustomize) from claiming the backend.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
-import jax  # noqa: E402
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+from mercury_tpu.platform import select_cpu_if_requested  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+select_cpu_if_requested()
+
+import jax  # noqa: E402
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402

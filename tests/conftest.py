@@ -14,11 +14,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+
+from mercury_tpu.platform import select_cpu_if_requested  # noqa: E402
+
+select_cpu_if_requested()
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the suite's cost is dominated by XLA CPU
 # compiles of the fused train-step programs (ResNet-50, MobileNetV2, scanned
