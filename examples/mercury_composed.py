@@ -1,0 +1,111 @@
+"""The flagship Mercury IS algorithm composed with model parallelism.
+
+Round-2 capability: the importance-sampled step is no longer dp-only —
+it runs with the model tensor-parallel, pipelined, or over a
+memory-scaled data layout. Three sections:
+
+1. **dp×tp Mercury** — `TrainConfig(tensor_parallel=2)`: the fused
+   scoring→draw→reweighted-backward→stat-psum program on a 2-D
+   data×model mesh, every transformer (here: ViT on images!) block
+   matmul Megatron-sharded; losses equal the unsharded run.
+2. **pp Mercury** — `train/pp_step.py`: pool scored through the GPipe
+   schedule, reweighted backward through its AD reverse, block params
+   staged across the pipe axis.
+3. **Sharded data placement** — `data_placement="sharded"`: per-device
+   train-data memory is one worker's shard row instead of the whole
+   dataset; losses are bit-identical to the replicated placement.
+
+Run (8 virtual devices, CPU):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/mercury_composed.py
+"""
+
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
+
+import jax
+import numpy as np
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.parallel.mesh import host_cpu_mesh
+from mercury_tpu.train.trainer import Trainer
+
+
+def section(title):
+    print(f"\n=== {title} ===")
+
+
+BASE = dict(dataset="synthetic", batch_size=8, presample_batches=2,
+            steps_per_epoch=4, num_epochs=1, eval_every=0, log_every=0,
+            compute_dtype="float32", seed=0)
+
+
+def run(tr, n=4):
+    out = []
+    for _ in range(n):
+        tr.state, m = tr.train_step(
+            tr.state, tr._step_x, tr._step_y, tr.dataset.shard_indices)
+        out.append(float(m["train/loss"]))
+    return out
+
+
+# 1. dp×tp Mercury on a ViT — image training with TP-sharded blocks.
+section("dp×tp Mercury (ViT, 2 workers × 2-way TP)")
+plain = Trainer(TrainConfig(model="vit", world_size=2, **BASE),
+                mesh=host_cpu_mesh(2))
+tp = Trainer(TrainConfig(model="vit", world_size=2, tensor_parallel=2,
+                         **BASE))
+l_plain, l_tp = run(plain), run(tp)
+specs = {str(l.sharding.spec)
+         for l in jax.tree_util.tree_leaves(tp.state.params)}
+print("unsharded losses:", [round(x, 4) for x in l_plain])
+print("tp losses:       ", [round(x, 4) for x in l_tp])
+print("param shardings include model axis:",
+      any("model" in s for s in specs))
+np.testing.assert_allclose(l_tp, l_plain, rtol=1e-4)
+
+# 2. pp Mercury — the IS loop through the GPipe schedule.
+section("pp Mercury (4-stage pipeline)")
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from mercury_tpu.models import TransformerClassifier
+from mercury_tpu.train.pp_step import create_pp_state, make_pp_mercury_step
+
+model = TransformerClassifier(num_classes=5, d_model=32, num_heads=2,
+                              num_layers=4, max_len=16)
+k1, k2 = jax.random.split(jax.random.key(0))
+x = jax.random.normal(k1, (256, 16, 8), jnp.float32)
+y = jax.random.randint(k2, (256,), 0, 5)
+mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+tx = optax.adam(1e-3)
+state = create_pp_state(jax.random.key(0), model, tx, x[:1],
+                        shard_len=len(x), mesh=mesh)
+step = make_pp_mercury_step(model, tx, mesh, batch_size=8,
+                            presample_batches=2, num_microbatches=2)
+losses = []
+for _ in range(6):
+    state, m = step(state, x, y)
+    losses.append(round(float(m["train/loss"]), 4))
+print("pp-mercury losses:", losses)
+leaf = jax.tree_util.tree_leaves(state.stacked)[0]
+print("block stack staged:", leaf.addressable_shards[0].data.shape[0],
+      "of", leaf.shape[0], "layers per device")
+
+# 3. Sharded data placement — scale the data layout past CIFAR.
+section('data_placement="sharded" (per-device data = one shard row)')
+rep = Trainer(TrainConfig(model="smallcnn", world_size=4, **BASE),
+              mesh=host_cpu_mesh(4))
+shd = Trainer(TrainConfig(model="smallcnn", world_size=4,
+                          data_placement="sharded", **BASE),
+              mesh=host_cpu_mesh(4))
+l_rep, l_shd = run(rep), run(shd)
+print("replicated losses:", [round(x, 4) for x in l_rep])
+print("sharded losses:   ", [round(x, 4) for x in l_shd])
+full = np.asarray(shd.dataset.x_train).nbytes
+per_dev = shd._step_x.addressable_shards[0].data.nbytes
+print(f"per-device train bytes: {per_dev:,} vs full {full:,} "
+      f"({per_dev / full:.1%})")
+np.testing.assert_array_equal(l_rep, l_shd)
+
+print("\nall sections passed")
