@@ -245,6 +245,14 @@ class Trainer:
                 has_pending=(config.use_importance_sampling
                              and config.pipelined_scoring),
             )
+            # Pre-place the whole state with the pinned shardings (a
+            # no-copy no-op for the already-committed params/opt): the
+            # first step then donates cleanly instead of warning about
+            # unusable host-resident sampler buffers and resharding on
+            # entry. device_put accepts the prefix sharding pytree, so
+            # groupwise/pending subtrees are covered too.
+            state_sh, _ = self._state_out_shardings
+            self.state = jax.device_put(self.state, state_sh)
         else:
             self._state_out_shardings = None
         # Multi-controller (multi-host) runs: the host-created state and
