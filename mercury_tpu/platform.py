@@ -22,13 +22,36 @@ import os
 def select_cpu_if_requested() -> bool:
     """Pin the CPU platform iff ``XLA_FLAGS`` carries the virtual-host-
     device flag. Returns whether the pin was applied. Call before any
-    ``jax.devices()`` / first computation."""
+    ``jax.devices()`` / first computation.
+
+    A pre-set ``JAX_PLATFORMS`` naming another backend is still
+    overridden — it is usually the PLUGIN's sitecustomize pin, not the
+    user (indistinguishable from here), and the virtual-host-device flag
+    is this project's explicit "run on CPU" request — but the override is
+    no longer silent: a warning records which backend lost. A user who
+    really wants the accelerator despite a globally-exported host-device
+    flag sets ``MERCURY_TPU_FORCE_PLATFORM=<backend>``, which always
+    wins."""
     if "xla_force_host_platform_device_count" not in os.environ.get(
         "XLA_FLAGS", ""
     ):
         return False
-    os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
+    forced = os.environ.get("MERCURY_TPU_FORCE_PLATFORM", "").strip()
+    if forced:
+        os.environ["JAX_PLATFORMS"] = forced
+        jax.config.update("jax_platforms", forced)
+        return forced == "cpu"
+    existing = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if existing and existing != "cpu":
+        import warnings
+
+        warnings.warn(
+            f"XLA_FLAGS requests virtual host devices; overriding "
+            f"JAX_PLATFORMS={existing!r} to 'cpu' (set "
+            "MERCURY_TPU_FORCE_PLATFORM to keep the other backend)"
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
     return True

@@ -21,6 +21,7 @@ from __future__ import annotations
 import functools
 import os
 import re
+import time
 from typing import Any, Optional, Tuple
 
 import jax
@@ -131,20 +132,27 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
     return path
 
 
-def _sweep_stale_tmps(directory: str) -> None:
-    """Unlink ``.msgpack.tmp`` strays left by a crash mid-write. Called
-    only from the restore path (startup — before any write of this run
-    can be in flight, so no async writer's temp can be racing; sweeping
-    on save would race an unjoined previous ``save_checkpoint_async``).
-    Without it, each preempted run leaks a checkpoint-sized orphan into
-    the (possibly shared) directory."""
+def _sweep_stale_tmps(directory: str, min_age_secs: float = 300.0) -> None:
+    """Unlink ``.msgpack.tmp`` strays left by a crash mid-write. Without
+    it, each preempted run leaks a checkpoint-sized orphan into the
+    (possibly shared) directory.
+
+    Only temps older than ``min_age_secs`` are removed: the restore path
+    also runs mid-run (elastic resume restores into a live trainer), where
+    an async writer's fresh ``.tmp`` may legitimately be in flight — age
+    gating means a racing sweep can never unlink a file another process
+    (or this one's writer thread) is about to ``os.replace``. Crash
+    orphans are by definition older than any live write."""
     if jax.process_index() != 0:
         return
+    now = time.time()
     try:
         for name in os.listdir(directory):
             if name.endswith(".msgpack.tmp"):
+                path = os.path.join(directory, name)
                 try:
-                    os.unlink(os.path.join(directory, name))
+                    if now - os.path.getmtime(path) >= min_age_secs:
+                        os.unlink(path)
                 except OSError:
                     pass
     except OSError:
@@ -258,7 +266,12 @@ def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None
     per-candidate success/failure is agreed GLOBALLY (all-gather of the
     local outcome) — a transient read error on one host must not leave it
     resuming an older step than its peers, which would silently mix
-    divergent states through the next gradient psum."""
+    divergent states through the next gradient psum. The agreed list is
+    capped to the NEWEST 256 steps (the fixed-size broadcast buffer): with
+    more checkpoints than that on disk, the multi-host fallback walk stops
+    after 256 candidates rather than trying every older file — 256
+    consecutive corrupt checkpoints means the directory, not a torn write,
+    is the problem."""
     if step is not None:
         return _restore_one(directory, template, step), step
     _sweep_stale_tmps(directory)

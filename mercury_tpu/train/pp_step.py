@@ -98,6 +98,17 @@ def make_pp_mercury_step(
             f"pool ({pool_size}) and batch ({batch_size}) must divide by "
             f"num_microbatches ({num_microbatches})"
         )
+    if getattr(model, "moe_experts", None) is not None:
+        # make_pp_apply would demand with_aux=True for a router model, but
+        # this step has no plumbing for the load-balancing aux loss — fail
+        # here with the actual constraint instead of relaying advice the
+        # caller cannot follow.
+        raise ValueError(
+            "make_pp_mercury_step does not support MoE models: the Switch "
+            "router's load-balancing aux loss is not plumbed through the "
+            "pipelined Mercury step; use a dense transformer here, or the "
+            "fused data-parallel step (make_train_step) for MoE"
+        )
     pp_fwd = make_pp_apply(model, mesh, num_microbatches, axis,
                            with_aux=False)
 
