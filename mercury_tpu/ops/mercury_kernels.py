@@ -18,10 +18,14 @@ the in-kernel TPU PRNG, so the draw is reproducible from a JAX key and the
 kernels run identically under ``interpret=True`` on CPU (how the test suite
 exercises them without a chip).
 
-Shapes here are small (pool ≤ a few thousand, classes ≤ 1024): each kernel
-is a single block, no grid — Mosaic pads to the (8, 128) f32 tile
-internally. The win is fusion (one HBM read of the logits, everything else
-in VMEM), not tiling.
+Shapes here are small-to-medium (pool up to tens of thousands, classes ≤
+1024): each kernel is a single block, no grid — Mosaic pads to the (8, 128)
+f32 tile internally. The win is fusion (one HBM read of the logits,
+everything else in VMEM), not tiling. The draw kernel's CDF is computed in
+``[T, T]`` chunks (T ≤ 512) with a running scalar prefix, so its VMEM
+footprint is O(N·B + T²) rather than the O(N²) a single lower-triangular
+matmul would need — a 4096-candidate pool costs a 1 MB triangle tile, not
+a 64 MB square.
 """
 
 from __future__ import annotations
@@ -121,20 +125,49 @@ per_sample_nll_pallas.defvjp(_vjp_fwd, _vjp_bwd)
 
 
 # ----------------------------------------------------------------- kernel 2
+def _pow2_divisor(n: int, cap: int = 512) -> int:
+    """Largest power-of-two divisor of ``n``, capped."""
+    t = cap
+    while t > 1 and n % t != 0:
+        t //= 2
+    return t
+
+
+def _cdf_chunk(n: int) -> int:
+    """CDF chunk size: the largest power-of-two divisor of ``n``, capped
+    at 512 — chunks tile the pool exactly and the in-kernel triangle mask
+    stays ≤ 1 MB regardless of pool size.
+
+    A pool whose largest power-of-two divisor is tiny (e.g. 625) would
+    unroll n/t near-scalar chunks into the Mosaic program; instead, such
+    pools fall back to the single [n, n] triangle when it fits VMEM
+    comfortably (n ≤ 1024 → ≤ 4 MB) — larger awkward pools are padded to
+    a 512-multiple by the wrapper before reaching the kernel."""
+    t = _pow2_divisor(n)
+    if t < 64 and n <= 1024:
+        return n
+    return t
+
+
 def _score_draw_kernel(
     losses_ref, ema_ref, uniforms_ref,
     probs_ref, selected_ref, scaled_ref,
-    *, alpha: float,
+    *, alpha: float, true_n: int,
 ):
-    """score → normalize → inverse-CDF draw → p·N gather, all in VMEM.
+    """score → normalize → chunked inverse-CDF draw → p·N gather, all in
+    VMEM.
 
     ``losses_ref``: [N, 1]; ``ema_ref``: [1, 1] (SMEM); ``uniforms_ref``:
     [1, B] iid U(0,1). Outputs: normalized probs [N, 1], selected pool
     positions [1, B] int32, scaled probs p·N [1, B].
 
-    Mosaic notes: ``cumsum`` has no TC lowering, so the CDF is a
-    lower-triangular matmul (MXU); everything is laid out so no in-kernel
-    transpose is needed.
+    Mosaic notes: ``cumsum`` has no TC lowering, so each chunk's local CDF
+    is a lower-triangular matmul (MXU) over a ``[T, T]`` tile, offset by
+    the running scalar prefix of the chunks before it. The inverse-CDF
+    count ``idx_b = #{j: cdf_j <= u_b}`` decomposes exactly over chunks
+    (each chunk contributes its own count), so chunking changes the VMEM
+    footprint — O(T²) instead of O(N²) — and nothing else. The loop over
+    N/T chunks is a static Python unroll (straight-line Mosaic program).
     """
     losses = losses_ref[:]                                # [N, 1]
     n = losses.shape[0]
@@ -143,25 +176,43 @@ def _score_draw_kernel(
     probs = scores / total                                # :112
     probs_ref[:] = probs
 
-    # CDF via lower-triangular matmul: cdf_j = Σ_{k≤j} p_k.
-    row = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
-    lower = (col <= row).astype(jnp.float32)              # [N, N]
-    cdf = jnp.dot(lower, probs, preferred_element_type=jnp.float32)  # [N, 1]
+    u = uniforms_ref[:]                                   # [1, B]
+    b = u.shape[1]
+    t = _cdf_chunk(n)
+    row = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    lower = (col <= row).astype(jnp.float32)              # [T, T]
 
     # Inverse-CDF sampling ≡ multinomial-with-replacement (:114):
-    # idx_b = #{ j : cdf_j <= u_b } clamped to N-1.
-    u = uniforms_ref[:]                                   # [1, B]
-    cmp = (cdf <= u).astype(jnp.int32)                    # [N, B] broadcast
-    idx = jnp.minimum(jnp.sum(cmp, axis=0, keepdims=True), n - 1)  # [1, B]
+    # idx_b = #{ j : cdf_j <= u_b }, accumulated chunk by chunk with the
+    # global prefix carried as a scalar.
+    counts = jnp.zeros((1, b), jnp.int32)
+    prefix = jnp.zeros((), jnp.float32)
+    for c in range(n // t):
+        pc = probs[c * t:(c + 1) * t, :]                  # [T, 1]
+        cdf_c = prefix + jnp.dot(
+            lower, pc, preferred_element_type=jnp.float32
+        )                                                 # [T, 1]
+        counts = counts + jnp.sum(
+            (cdf_c <= u).astype(jnp.int32), axis=0, keepdims=True
+        )
+        prefix = prefix + jnp.sum(pc)
+    # Clamp to the REAL pool: padded rows (wrapper-added, score 1e-12)
+    # carry ~zero probability, and the clamp guarantees a draw can never
+    # land on one even at u → 1.
+    idx = jnp.minimum(counts, true_n - 1)                 # [1, B]
     selected_ref[:] = idx
 
-    # scaled_b = p[idx_b]·N via one-hot mask-and-reduce (gather-free).
-    b = u.shape[1]
+    # scaled_b = p[idx_b]·N via one-hot mask-and-reduce (gather-free;
+    # [N, B] is O(N·B) — pool·batch, not pool², so it stays unchunked).
+    # N is the REAL pool size: the p·N reweight contract (:116) is about
+    # the candidate count the caller drew from, not the padded tile.
     onehot = (
         jax.lax.broadcasted_iota(jnp.int32, (n, b), 0) == idx
     ).astype(jnp.float32)                                 # [N, B]
-    scaled_ref[:] = jnp.sum(onehot * (probs * n), axis=0, keepdims=True)  # p·N (:116)
+    scaled_ref[:] = jnp.sum(
+        onehot * (probs * true_n), axis=0, keepdims=True
+    )  # p·N (:116)
 
 
 def score_and_draw_pallas(
@@ -179,12 +230,23 @@ def score_and_draw_pallas(
     ``p·N`` pipeline (``mercury_tpu.sampling.importance``).
     """
     n = losses.shape[0]
+    n_pad = n
+    if _pow2_divisor(n) < 64 and n > 1024:
+        # Awkward large pool (tiny power-of-two divisor): pad to the next
+        # 512-multiple so the chunked CDF tiles exactly. Pad losses of
+        # -1e30 clamp to score 1e-12 (≈ zero probability); the kernel's
+        # idx clamp and p·N scale both use the true n.
+        n_pad = -(-n // 512) * 512
+        losses = jnp.concatenate([
+            losses.astype(jnp.float32),
+            jnp.full((n_pad - n,), -1e30, jnp.float32),
+        ])
     uniforms = jax.random.uniform(key, (1, batch_size), jnp.float32)
-    kernel = functools.partial(_score_draw_kernel, alpha=alpha)
+    kernel = functools.partial(_score_draw_kernel, alpha=alpha, true_n=n)
     probs, selected, scaled = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
             jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
             jax.ShapeDtypeStruct((1, batch_size), jnp.float32),
         ),
@@ -204,4 +266,4 @@ def score_and_draw_pallas(
         ema_value.reshape(1, 1).astype(jnp.float32),
         uniforms,
     )
-    return probs[:, 0], selected[0, :], scaled[0, :]
+    return probs[:n, 0], selected[0, :], scaled[0, :]
