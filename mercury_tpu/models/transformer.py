@@ -201,20 +201,46 @@ class TransformerClassifier(nn.Module):
             x = x.reshape(b, (h // p) * (w // p), p * p * c)
         _, t, _ = x.shape
         x = self.embed_proj(x)
+        pe = self.pos_embed.astype(self.compute_dtype)
         if self.sp_axis is None:
             global_len = t
-            offset = 0
-        else:
-            # Global positions for this sequence shard.
-            global_len = t * lax.axis_size(self.sp_axis)
-            offset = lax.axis_index(self.sp_axis) * t
+            if global_len > self.max_len:
+                raise ValueError(
+                    f"sequence length {global_len} exceeds max_len="
+                    f"{self.max_len}"
+                )
+            return x + pe[None, :t]
+        global_len = t * lax.axis_size(self.sp_axis)
         if global_len > self.max_len:
             raise ValueError(
                 f"sequence length {global_len} exceeds max_len={self.max_len}"
             )
-        pos = lax.dynamic_slice_in_dim(
-            self.pos_embed.astype(self.compute_dtype), offset, t, axis=0
-        )
+        if self.sp_impl == "zigzag":
+            # Zigzag layout (parallel/sequence.py zigzag_order): rank i's
+            # shard is global chunks (i, 2W-1-i) — the caller feeds tokens
+            # permuted with zigzag_order, and the positional embedding
+            # follows the same assignment (two chunk slices instead of one
+            # contiguous run). Downstream this composes exactly: blocks
+            # are pointwise over tokens, zigzag_ring_attention reconstructs
+            # causal relations from the layout, and the head's mean pool
+            # is permutation-invariant — so logits match the dense model
+            # on the unpermuted sequence.
+            if t % 2 != 0:
+                raise ValueError(
+                    f"zigzag layout needs an even local length, got {t}"
+                )
+            w = lax.axis_size(self.sp_axis)
+            i = lax.axis_index(self.sp_axis)
+            c = t // 2
+            pos = jnp.concatenate([
+                lax.dynamic_slice_in_dim(pe, i * c, c, axis=0),
+                lax.dynamic_slice_in_dim(pe, (2 * w - 1 - i) * c, c, axis=0),
+            ], axis=0)
+        else:
+            # Contiguous layout: global positions for this sequence shard.
+            pos = lax.dynamic_slice_in_dim(
+                pe, lax.axis_index(self.sp_axis) * t, t, axis=0
+            )
         return x + pos[None]
 
     def head(self, x):

@@ -404,3 +404,145 @@ class TestTransformerTraining:
             losses.append(float(m["train/loss"]))
         assert all(np.isfinite(l) for l in losses)
         assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+class TestZigzagRingAttention:
+    """Balanced causal ring: exact vs dense on the unpermuted sequence,
+    and measurably cheaper — the naive ring executes every future block's
+    matmuls; zigzag does half the hop FLOPs."""
+
+    @staticmethod
+    def zigzag_sharded(mesh, q, k, v, causal):
+        from mercury_tpu.parallel.sequence import zigzag_ring_attention
+
+        fn = shard_map(
+            functools.partial(zigzag_ring_attention, axis_name="seq",
+                              causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"),
+        )
+        return jax.jit(fn)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        from mercury_tpu.parallel.sequence import zigzag_inverse, zigzag_order
+
+        q, k, v = make_qkv(jax.random.key(3))
+        mesh = seq_mesh()
+        perm = zigzag_order(L, 8)
+        inv = zigzag_inverse(L, 8)
+        out_z = self.zigzag_sharded(mesh, q, k, v, causal)(
+            q[:, perm], k[:, perm], v[:, perm]
+        )
+        want = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out_z[:, inv]), np.asarray(want), atol=2e-5
+        )
+
+    def test_grads_match_dense(self):
+        from mercury_tpu.parallel.sequence import zigzag_inverse, zigzag_order
+
+        q, k, v = make_qkv(jax.random.key(4))
+        mesh = seq_mesh()
+        perm = zigzag_order(L, 8)
+        inv = zigzag_inverse(L, 8)
+        zz = self.zigzag_sharded(mesh, q, k, v, True)
+
+        def loss_z(q, k, v):
+            return jnp.sum(zz(q[:, perm], k[:, perm], v[:, perm])[:, inv] ** 2)
+
+        def loss_d(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+        g_z = jax.grad(loss_z, argnums=(0, 1, 2))(q, k, v)
+        g_d = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+        for gz, gd in zip(g_z, g_d):
+            np.testing.assert_allclose(np.asarray(gz), np.asarray(gd),
+                                       atol=5e-5)
+
+    def test_half_the_flops_of_naive_ring(self):
+        """The acceptance bar from the design: causal zigzag's compiled
+        FLOP count is ~half the naive causal ring's (which pays full
+        non-causal cost). Measured via XLA cost analysis on the whole
+        sharded program."""
+        from mercury_tpu.parallel.sequence import zigzag_order
+
+        q, k, v = make_qkv(jax.random.key(5))
+        mesh = seq_mesh()
+        perm = zigzag_order(L, 8)
+
+        naive = shard_map(
+            functools.partial(ring_attention, axis_name="seq", causal=True),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+        )
+        zz = self.zigzag_sharded(mesh, q, k, v, True)
+        flops_naive = jax.jit(naive).lower(q, k, v).compile().cost_analysis()[
+            "flops"
+        ]
+        flops_zz = (
+            zz.lower(q[:, perm], k[:, perm], v[:, perm])
+            .compile().cost_analysis()["flops"]
+        )
+        # Zigzag folds 2 of the naive hop's 4 chunk-pair matmuls (self hop
+        # identical); allow overhead headroom but require a real cut.
+        assert flops_zz < 0.75 * flops_naive, (flops_zz, flops_naive)
+
+    def test_zigzag_order_roundtrip(self):
+        from mercury_tpu.parallel.sequence import zigzag_inverse, zigzag_order
+
+        perm = zigzag_order(32, 4)
+        inv = zigzag_inverse(32, 4)
+        x = np.arange(32)
+        np.testing.assert_array_equal(x[perm][inv], x)
+        # Shard 0 of the permuted array = chunks 0 and 7.
+        np.testing.assert_array_equal(
+            perm[:8], np.concatenate([np.arange(0, 4), np.arange(28, 32)])
+        )
+
+    def test_dispatcher(self):
+        from mercury_tpu.parallel.sequence import attention, zigzag_order
+
+        q, k, v = make_qkv(jax.random.key(6))
+        mesh = seq_mesh()
+        perm = zigzag_order(L, 8)
+        fn = shard_map(
+            functools.partial(attention, causal=True, sp_axis="seq",
+                              sp_impl="zigzag"),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+        )
+        out = jax.jit(fn)(q[:, perm], k[:, perm], v[:, perm])
+        assert out.shape == (B, L, H, D)
+
+
+class TestTransformerZigzag:
+    def test_transformer_zigzag_matches_dense(self):
+        """sp_impl='zigzag' through the TransformerClassifier: input
+        tokens fed in zigzag_order, pos-embed follows the chunk
+        assignment, mean-pool head is permutation-invariant — logits
+        match the unsharded causal forward exactly."""
+        from mercury_tpu.parallel.sequence import zigzag_order
+
+        kw = dict(num_classes=5, d_model=32, num_heads=4, num_layers=2,
+                  max_len=64, causal=True)
+        dense_model = TransformerClassifier(**kw)
+        sp_model = TransformerClassifier(sp_axis="seq", sp_impl="zigzag",
+                                         **kw)
+        x = jax.random.normal(jax.random.key(23), (4, 64, 12), jnp.float32)
+        variables = dense_model.init(jax.random.key(24), x, train=False)
+        ref = dense_model.apply(variables, x, train=False)
+        mesh = seq_mesh(4)
+        perm = zigzag_order(64, 4)
+        fn = shard_map(
+            lambda v, x: sp_model.apply(v, x, train=False),
+            mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=P(),
+        )
+        out = jax.jit(fn)(variables, x[:, perm])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
