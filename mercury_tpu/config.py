@@ -101,6 +101,18 @@ class TrainConfig:
     #   reweighting stays unbiased for any score.
     importance_score: str = "loss"
     sync_importance_stats: bool = True  # north-star: psum (sum_loss, count) across workers
+    # Score-refresh cadence (pool sampler only): score a fresh candidate
+    # pool every K-th step and CACHE the resulting sampling distribution;
+    # the K-1 steps in between redraw their train batch from the cached
+    # pool (fresh multinomial draws + fresh augmentation, same probs).
+    # Scoring is the dominant IS cost (a pool/batch-sized extra forward
+    # per step — the reference pays it every step, pytorch_collab.py:95),
+    # so cadence K amortizes that cost by K at the price of K-step-stale
+    # scores. The 1/(N·p) reweighting still matches the distribution the
+    # batch was ACTUALLY drawn from, so the estimator stays unbiased for
+    # the cached scores' selection. 1 = reference behavior (fresh pool
+    # every step).
+    score_refresh_every: int = 1
     # Pipelined scoring (pool sampler only): step t trains on the batch
     # selected at step t-1 and scores the NEXT pool with the same params —
     # the train fwd/bwd and the scoring forward become independent, so XLA

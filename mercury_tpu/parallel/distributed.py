@@ -93,31 +93,65 @@ def _from_host(value: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
 
 
 def globalize_state(state, mesh: Mesh, axis_name: str = "data",
-                    zero_sharding: bool = False):
+                    zero_sharding: bool = False,
+                    params_sharding=None, opt_sharding=None):
     """Re-place a host-created ``MercuryState`` as global arrays on a
     (possibly multi-process) mesh: model/optimizer state replicated,
-    per-worker sampler state (EMA/streams/RNG/groupwise/pending) sharded
-    along ``axis_name`` — the multi-controller twin of
-    ``train.step._state_specs``. Under ZeRO-1 (``zero_sharding``) the
+    per-worker sampler state (EMA/streams/RNG/groupwise/pending/
+    cached-pool) sharded along ``axis_name`` — the multi-controller twin
+    of ``train.step._state_specs``. Under ZeRO-1 (``zero_sharding``) the
     optimizer state is chunk-sharded along ``axis_name`` too, matching the
     step's specs (each host only materializes its workers' moment chunks).
     Each process must hold the identical host state (``create_state`` is
     deterministic in the seed), mirroring the reference's implicit
-    same-seed init before ``average_model`` (``pytorch_collab.py:84-87``)."""
+    same-seed init before ``average_model`` (``pytorch_collab.py:84-87``).
+
+    ``params_sharding``/``opt_sharding``: optional trees of committed
+    ``NamedSharding`` leaves (the tensor-parallel Megatron layout,
+    ``parallel/tensor.py``) — with them the model state is placed in that
+    layout instead of replicated, which is what lets dp×tp run
+    multi-controller: every process holds the same full host value and
+    materializes only its addressable shards of the TP split. A ``None``
+    ``opt_state`` (deferred TP optimizer init) passes through — the
+    caller inits it from the placed params."""
     rep = lambda t: jax.tree.map(lambda x: make_global_array(x, mesh, P()), t)
     shd = lambda t: jax.tree.map(
         lambda x: make_global_array(x, mesh, P(axis_name)), t
     )
+
+    def committed(t, sh_tree):
+        # NamedSharding is not a pytree node, so each spec arrives whole.
+        return jax.tree.map(
+            lambda x, sh: jax.make_array_from_callback(
+                np.shape(x), sh, lambda idx: np.asarray(x)[idx]
+            ),
+            t, sh_tree,
+        )
+
+    if params_sharding is not None:
+        params = committed(state.params, params_sharding)
+    else:
+        params = rep(state.params)
+    if state.opt_state is None:
+        opt_state = None
+    elif opt_sharding is not None:
+        opt_state = committed(state.opt_state, opt_sharding)
+    elif zero_sharding:
+        opt_state = shd(state.opt_state)
+    else:
+        opt_state = rep(state.opt_state)
     return state.replace(
         step=make_global_array(state.step, mesh, P()),
-        params=rep(state.params),
+        params=params,
         batch_stats=rep(state.batch_stats),
-        opt_state=shd(state.opt_state) if zero_sharding else rep(state.opt_state),
+        opt_state=opt_state,
         ema=shd(state.ema),
         stream=shd(state.stream),
         rng=shd(state.rng),
         groupwise=None if state.groupwise is None else shd(state.groupwise),
         pending=None if state.pending is None else shd(state.pending),
+        cached_pool=(None if state.cached_pool is None
+                     else shd(state.cached_pool)),
     )
 
 
@@ -166,11 +200,16 @@ def worker_shard_global_arrays(
     def build(values, shape_tail, dtype):
         def cb(idx):
             rows = range(*idx[0].indices(W))
-            block = np.stack([values[sidx[w]] for w in rows])
+            # astype makes the dtype contract real (not merely inherited
+            # from values): the global array's declared dtype below must
+            # match every callback block.
+            block = np.stack([values[sidx[w]] for w in rows]).astype(
+                dtype, copy=False
+            )
             return block[(slice(None),) + tuple(idx[1:])]
 
         return jax.make_array_from_callback(
-            (W, L) + shape_tail, sharding, cb
+            (W, L) + shape_tail, sharding, cb, dtype=dtype
         )
 
     return (build(xs, xs.shape[1:], xs.dtype),

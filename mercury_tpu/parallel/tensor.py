@@ -27,6 +27,7 @@ pinned by ``tests/test_tensor_parallel.py``.
 from __future__ import annotations
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # (suffix of the flattened param path) → partition spec builder.
@@ -72,3 +73,49 @@ def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
     """Place a param tree with the tensor-parallel layout (each device
     holds ``1/axis_size`` of every block matmul's weights)."""
     return jax.device_put(params, transformer_tp_shardings(params, mesh, axis))
+
+
+def opt_sharding_like(opt_shapes, params, param_sharding, mesh: Mesh):
+    """Sharding tree for an optimizer state, derived STRUCTURALLY from the
+    param shardings: optax moment trees (Adam's mu/nu, momentum traces,
+    MultiSteps accumulators) embed the param tree, so an optimizer leaf
+    whose tree-path SUFFIX matches a param path (same shape) inherits that
+    param's sharding; everything else (step counts, empty states) is
+    replicated.
+
+    This exists because inferring the layout from a jitted ``tx.init``'s
+    output shardings is fragile — multi-controller jit can hand back
+    non-``NamedSharding`` objects, and ``zeros_like`` gives XLA no
+    constraint to propagate — while the structural mapping is exact by
+    optax's own state construction.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.tree_util import (
+        tree_flatten_with_path,
+        tree_unflatten,
+    )
+
+    def path_key(path):
+        return tuple(str(k) for k in path)
+
+    by_path = {}
+    param_leaves, _ = tree_flatten_with_path(params)
+    sh_leaves, _ = tree_flatten_with_path(param_sharding)
+    for (ppath, pleaf), (spath, sh) in zip(param_leaves, sh_leaves):
+        assert path_key(ppath) == path_key(spath)
+        by_path[path_key(ppath)] = (tuple(np.shape(pleaf)), sh)
+
+    leaves, treedef = tree_flatten_with_path(opt_shapes)
+    out = []
+    for path, leaf in leaves:
+        keys = path_key(path)
+        shape = tuple(leaf.shape) if hasattr(leaf, "shape") else ()
+        chosen = None
+        for i in range(len(keys)):
+            hit = by_path.get(keys[i:])
+            if hit is not None and hit[0] == shape:
+                chosen = hit[1]
+                break
+        out.append(chosen if chosen is not None
+                   else NamedSharding(mesh, P()))
+    return tree_unflatten(treedef, out)

@@ -1,0 +1,198 @@
+"""Elastic resume: restore a checkpoint into a DIFFERENT world size.
+
+The reference cannot survive topology change at all — any worker loss hangs
+the gloo group forever (``pytorch_collab.py:291-292`` joins forked workers
+that block in collectives; SURVEY.md §5 "failure detection: none"). Plain
+``restore_checkpoint`` here already beats that for same-shape restarts;
+this module handles the genuinely elastic case: train W-way, come back
+W′-way (preemption shrank the pod, or it grew back).
+
+What transfers and what re-derives, by world-size dependence:
+
+- **model state** (params, BN stats, step) — world-size independent:
+  restored exactly; the learning trajectory continues bit-for-bit in the
+  weights.
+- **optimizer state** — exact for the replicated layout; under ZeRO-1 the
+  ``[W, ceil(P/W)]`` moment chunks are a flat view of the parameter-sized
+  moment vector, so W→W′ resharding is concat → trim to P → re-pad →
+  re-chunk: the moments also transfer exactly.
+- **per-worker sampler state** (streams, RNG, groupwise scores, cached
+  pool, pending batch) — indexed by the W-way Dirichlet partition, which
+  a W′-way run re-draws as W′ different shards: the old values are
+  meaningless under the new partition, so they re-derive deterministically
+  from (config seed, restored step): fresh streams over the new shards and
+  per-worker keys folded with the restored step (a resumed run never
+  repeats the step-0 draw sequence).
+- **EMA of the pool loss** — a cross-worker statistic, not a per-shard
+  one (under ``sync_importance_stats`` every worker holds the same
+  value): the new workers warm-start from the old workers' mean instead
+  of re-bootstrapping, so the importance scores stay smoothed through the
+  topology change.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mercury_tpu.sampling.importance import EMAState
+from mercury_tpu.train import checkpoint as ckpt
+from mercury_tpu.train.state import MercuryState
+
+
+def _read_raw_state(directory: str, template: MercuryState,
+                    step: Optional[int] = None) -> Tuple[Any, int]:
+    """Read a checkpoint WITHOUT shape-checking against the template:
+    returns a template-structured tree whose leaves keep their on-disk
+    (old-world) shapes, plus the step. PRNG keys stay as raw uint32 key
+    data (the caller re-derives RNG anyway)."""
+    import flax.serialization
+
+    if step is None:
+        step = ckpt.latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = ckpt._ckpt_path(directory, step)
+    if os.path.isdir(path):
+        ocp = ckpt._orbax()
+        assert ocp is not None, "directory checkpoint needs orbax"
+        raw = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+        raw = _lists_to_dicts(raw)
+    else:
+        with open(path + ".msgpack", "rb") as f:
+            raw = flax.serialization.msgpack_restore(f.read())
+    # from_state_dict maps the raw dict back onto the template STRUCTURE
+    # without reshaping values — exactly what elastic needs: old-shape
+    # leaves inside a navigable MercuryState.
+    state_shaped = flax.serialization.from_state_dict(
+        ckpt._unwrap_keys(template), raw
+    )
+    return state_shaped, step
+
+
+def _lists_to_dicts(tree: Any) -> Any:
+    """Orbax restores tuple nodes as real lists; flax's ``from_state_dict``
+    expects the msgpack convention (dicts keyed by the stringified index).
+    Normalize so both save formats feed the same restore path."""
+    if isinstance(tree, (list, tuple)):
+        return {str(i): _lists_to_dicts(v) for i, v in enumerate(tree)}
+    if isinstance(tree, dict):
+        return {k: _lists_to_dicts(v) for k, v in tree.items()}
+    return tree
+
+
+def _reshard_zero_opt(old_opt: Any, new_opt: Any, w_old: int, w_new: int,
+                      n_params: int) -> Any:
+    """ZeRO-1 moment chunks ``[W, C]`` → ``[W′, C′]``: the chunks are a
+    padded flat view of the parameter-sized moment vector, so resharding
+    is exact — concat, trim the old padding, re-pad, re-chunk. Per-chunk
+    scalar leaves (Adam's step count, ``[W]``) broadcast their (identical)
+    first entry."""
+
+    def leaf(o, n):
+        o = np.asarray(o)
+        want = np.shape(n)
+        if o.shape == want:
+            return o
+        if o.ndim >= 2 and o.shape[0] == w_old and want[0] == w_new:
+            full = o.reshape((w_old * o.shape[1],) + o.shape[2:])[:n_params]
+            c_new = want[1]
+            pad = w_new * c_new - n_params
+            full = np.concatenate(
+                [full, np.zeros((pad,) + full.shape[1:], full.dtype)]
+            )
+            return full.reshape((w_new, c_new) + o.shape[2:])
+        if o.ndim == 1 and o.shape[0] == w_old and want == (w_new,):
+            return np.full(w_new, o[0], o.dtype)
+        raise ValueError(
+            f"cannot reshard optimizer leaf {o.shape} -> {want} "
+            f"(W {w_old} -> {w_new})"
+        )
+
+    return jax.tree_util.tree_map(leaf, old_opt, new_opt)
+
+
+def _check_same(old: Any, new: Any, what: str) -> Any:
+    def leaf(o, n):
+        if np.shape(o) != np.shape(n):
+            raise ValueError(
+                f"{what} shape mismatch {np.shape(o)} vs {np.shape(n)}: "
+                "elastic resume requires the same model/optimizer config"
+            )
+        return np.asarray(o)
+
+    return jax.tree_util.tree_map(leaf, old, new)
+
+
+def elastic_restore(directory: str, trainer,
+                    step: Optional[int] = None) -> int:
+    """Restore ``directory``'s checkpoint (saved at any world size) into
+    ``trainer`` (built at the new world size). Returns the restored step.
+
+    The trainer's freshly-initialized state supplies everything the new
+    topology defines (streams over the new partition, per-worker RNG,
+    groupwise/cached-pool/pending placeholders); the checkpoint supplies
+    the learning trajectory (params, BN stats, optimizer moments, step,
+    EMA warm start). See the module docstring for the rationale per field.
+    """
+    # Work from a fully host-resident view of the template: in a
+    # multi-controller run the live state's sampler leaves are global
+    # arrays spanning non-addressable devices — np.asarray on those (or
+    # re-globalizing them) would raise. _host_gather is collective
+    # (all-gather of cross-process shards), and every process calls
+    # elastic_restore, so this is safe by the same argument as
+    # save_checkpoint's gather.
+    live = trainer.state
+    template = ckpt._rewrap_keys(
+        live, ckpt._host_gather(ckpt._unwrap_keys(live))
+    )
+    old, restored_step = _read_raw_state(directory, template, step)
+    w_old = int(np.shape(old.ema.value)[0])
+    w_new = int(np.shape(template.ema.value)[0])
+
+    params = _check_same(old.params, ckpt._unwrap_keys(template).params,
+                         "params")
+    batch_stats = _check_same(old.batch_stats, template.batch_stats,
+                              "batch_stats")
+    if trainer.config.zero_sharding and w_old != w_new:
+        from mercury_tpu.utils.tree import tree_flatten_to_vector
+
+        pvec, _ = tree_flatten_to_vector(template.params)
+        opt_state = _reshard_zero_opt(old.opt_state, template.opt_state,
+                                      w_old, w_new, int(pvec.size))
+    else:
+        opt_state = _check_same(old.opt_state, template.opt_state,
+                                "opt_state")
+
+    # EMA warm start: mean over the old workers (identical values under
+    # sync_importance_stats), count carried so the bootstrap doesn't rerun.
+    ema_val = float(np.mean(np.asarray(old.ema.value)))
+    ema_cnt = int(np.max(np.asarray(old.ema.count)))
+    ema = EMAState(
+        value=jnp.full((w_new,), ema_val, jnp.float32),
+        count=jnp.full((w_new,), ema_cnt, jnp.int32),
+    )
+    # Per-worker RNG: the new topology's keys, folded with the restored
+    # step — deterministic, and never re-plays the step-0 sequence.
+    rng = jax.vmap(lambda k: jax.random.fold_in(k, restored_step))(
+        template.rng
+    )
+
+    trainer.state = template.replace(
+        step=jnp.asarray(int(old.step), jnp.int32),
+        params=jax.tree_util.tree_map(jnp.asarray, params),
+        batch_stats=jax.tree_util.tree_map(jnp.asarray, batch_stats),
+        opt_state=jax.tree_util.tree_map(jnp.asarray, opt_state),
+        ema=ema,
+        rng=rng,
+        # stream/groupwise/pending/cached_pool: the template's fresh,
+        # deterministic initialization over the NEW partition.
+    )
+    # Re-placement (global arrays multi-controller, committed TP layout)
+    # is the caller's job — Trainer.restore_elastic runs the same
+    # _recommit_state step the plain restore path uses.
+    return restored_step

@@ -22,6 +22,22 @@ from mercury_tpu.sampling.groupwise import GroupwiseState, init_groupwise
 from mercury_tpu.sampling.importance import EMAState, init_ema
 
 
+class CachedPool(NamedTuple):
+    """A scored candidate pool reused across steps (score-refresh cadence,
+    ``config.score_refresh_every > 1``).
+
+    Refreshed every K-th step: the freshly streamed pool's shard slots and
+    the normalized importance distribution computed from its scores
+    (``update_samples``'s score→normalize, ``pytorch_collab.py:108-112``).
+    Intermediate steps redraw from ``probs`` (fresh multinomial draws ≡
+    ``:114``) and re-gather/re-augment by slot — the scoring forward, the
+    dominant per-step IS cost, runs once per K steps."""
+
+    slots: jax.Array      # [P] int32 — pool positions into the worker shard
+    probs: jax.Array      # [P] float32 — normalized sampling distribution
+    pool_loss: jax.Array  # [] float32 — pool-loss metric from the refresh
+
+
 class PendingBatch(NamedTuple):
     """The next step's pre-selected train batch (pipelined scoring).
 
@@ -46,6 +62,7 @@ class MercuryState:
     rng: jax.Array                  # [W, key] per-worker PRNG keys
     groupwise: Any = None           # [W]-stacked GroupwiseState (sampler="groupwise")
     pending: Any = None             # [W]-stacked PendingBatch (pipelined_scoring)
+    cached_pool: Any = None         # [W]-stacked CachedPool (score_refresh_every>1)
 
 
 def create_state(
@@ -60,6 +77,7 @@ def create_state(
     pending_sample_shape: Optional[tuple] = None,
     zero_sharding: bool = False,
     init_opt: bool = True,
+    cached_pool_size: int = 0,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -122,6 +140,17 @@ def create_state(
             labels=jnp.zeros((n_workers, pending_batch_size), jnp.int32),
             scaled_probs=jnp.ones((n_workers, pending_batch_size), jnp.float32),
         )
+    cached_pool = None
+    if cached_pool_size:
+        # Placeholder only — step 0's refresh branch fires (step % K == 0)
+        # and overwrites it before any draw happens; uniform probs keep the
+        # placeholder a valid distribution regardless.
+        cached_pool = CachedPool(
+            slots=jnp.zeros((n_workers, cached_pool_size), jnp.int32),
+            probs=jnp.full((n_workers, cached_pool_size),
+                           1.0 / cached_pool_size, jnp.float32),
+            pool_loss=jnp.zeros((n_workers,), jnp.float32),
+        )
     return MercuryState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -132,6 +161,7 @@ def create_state(
         rng=worker_keys,
         groupwise=groupwise,
         pending=pending,
+        cached_pool=cached_pool,
     )
 
 

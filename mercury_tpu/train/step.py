@@ -34,21 +34,23 @@ from mercury_tpu.data.pipeline import ShardStream, augment_batch, next_pool, nor
 from mercury_tpu.parallel.collectives import allreduce_mean_tree
 from mercury_tpu.sampling.importance import (
     EMAState,
+    draw_with_replacement,
     ema_update,
+    importance_probs,
     per_sample_grad_norm_bound,
     per_sample_loss,
     pool_mean,
     reweighted_loss,
     select_from_pool,
 )
-from mercury_tpu.train.state import MercuryState, PendingBatch
+from mercury_tpu.train.state import CachedPool, MercuryState, PendingBatch
 
 from jax import shard_map
 
 
 def _state_specs(
     axis: str, has_groupwise: bool = False, has_pending: bool = False,
-    zero_sharding: bool = False,
+    zero_sharding: bool = False, has_cached_pool: bool = False,
 ) -> MercuryState:
     """PartitionSpec pytree-prefix for :class:`MercuryState`: model state
     replicated, per-worker sampler state sharded along the data axis;
@@ -64,12 +66,14 @@ def _state_specs(
         rng=P(axis),
         groupwise=P(axis) if has_groupwise else None,
         pending=P(axis) if has_pending else None,
+        cached_pool=P(axis) if has_cached_pool else None,
     )
 
 
 def mercury_state_out_shardings(
     mesh: Mesh, axis: str, params_sh, opt_sh,
     has_groupwise: bool = False, has_pending: bool = False,
+    has_cached_pool: bool = False,
 ) -> Tuple[MercuryState, Any]:
     """Output shardings pinning the post-step state layout under partial-
     auto meshes (dp×tp): without this, GSPMD is free to re-replicate the
@@ -91,6 +95,7 @@ def mercury_state_out_shardings(
         rng=n(P(axis)),
         groupwise=n(P(axis)) if has_groupwise else None,
         pending=n(P(axis)) if has_pending else None,
+        cached_pool=n(P(axis)) if has_cached_pool else None,
     )
     return state_sh, n(P())
 
@@ -167,6 +172,23 @@ def make_train_step(
     zero = config.zero_sharding
     if pipelined and use_groupwise:
         raise ValueError("pipelined_scoring requires sampler='pool'")
+    cadence = int(config.score_refresh_every)
+    if cadence < 1:
+        raise ValueError(
+            f"score_refresh_every must be >= 1, got {cadence}"
+        )
+    use_cadence = use_is and cadence > 1
+    if use_cadence and use_groupwise:
+        raise ValueError(
+            "score_refresh_every > 1 requires sampler='pool' (the "
+            "groupwise sampler already persists scores across steps)"
+        )
+    if use_cadence and pipelined:
+        raise ValueError(
+            "score_refresh_every > 1 does not compose with "
+            "pipelined_scoring: cadence already removes the per-step "
+            "scoring forward the pipeline overlaps"
+        )
 
     if config.importance_score not in ("loss", "grad_norm"):
         raise ValueError(
@@ -290,6 +312,20 @@ def make_train_step(
         stream = ShardStream(perm=state.stream.perm[0], cursor=state.stream.cursor[0])
         ema = EMAState(value=state.ema.value[0], count=state.ema.count[0])
 
+        def score_slots(slots, ka):
+            """Gather → augment → inference-mode scoring forward — the
+            pool-scoring prologue shared by the inline, pipelined,
+            cadence, and groupwise IS paths (one definition so a change
+            to scoring cannot drift between them)."""
+            raw, labs = gather_train(slots)
+            imgs = _augment(ka, normalize_images(raw, mean, std))
+            pool_logits, _, _ = _apply_train(
+                state.params, state.batch_stats, imgs, False
+            )
+            return imgs, labs, pool_logits, _score_per_sample(
+                pool_logits, labs
+            )
+
         if pipelined:
             # --- pipelined scoring: train on the batch selected last step,
             # score the NEXT pool with the same (pre-update) params — the
@@ -299,12 +335,7 @@ def make_train_step(
             # (pytorch_collab.py:158-164). --------------------------------
             def score_next(stream, ema, ks, ka, ksel):
                 stream, slots = next_pool(stream, ks, pool_size)
-                raw, labs = gather_train(slots)
-                imgs = _augment(ka, normalize_images(raw, mean, std))
-                pool_logits, _, _ = _apply_train(
-                    state.params, state.batch_stats, imgs, False
-                )
-                pool_losses = _score_per_sample(pool_logits, labs)
+                imgs, labs, pool_logits, pool_losses = score_slots(slots, ka)
                 selected, scaled, ema, avg = _select(ksel, pool_losses, ema)
                 pend = PendingBatch(
                     images=imgs[selected], labels=labs[selected],
@@ -334,6 +365,48 @@ def make_train_step(
             stream, ema, new_pending, avg_pool_loss = score_next(
                 stream, ema, k_stream, k_aug, k_sel
             )
+        elif use_cadence:
+            # --- score-refresh cadence: every K-th step stream + score a
+            # fresh pool and cache its normalized importance distribution;
+            # the K-1 steps in between redraw from the cache (fresh
+            # multinomial draws ≡ pytorch_collab.py:114, fresh
+            # augmentation) and skip the scoring forward entirely — the
+            # dominant per-step IS cost amortizes by K. The 1/(N·p)
+            # reweight uses the cached probs the batch was actually drawn
+            # from, so the estimator stays unbiased for those scores. ----
+            cached = jax.tree_util.tree_map(lambda x: x[0], state.cached_pool)
+
+            def refresh(args):
+                stream, ema, _ = args
+                stream, slots = next_pool(stream, k_stream, pool_size)
+                _, labs, pool_logits, pool_losses = score_slots(
+                    slots, k_aug
+                )
+                avg = pool_mean(pool_losses, stat_axis)
+                ema = ema_update(ema, avg, config.ema_alpha)
+                probs = importance_probs(
+                    pool_losses, ema.value, config.is_alpha
+                )
+                pool = CachedPool(
+                    slots=slots.astype(jnp.int32),
+                    probs=probs,
+                    pool_loss=_pool_loss_metric(pool_logits, labs, avg),
+                )
+                return stream, ema, pool
+
+            def reuse(args):
+                return args
+
+            stream, ema, cached = lax.cond(
+                state.step % cadence == 0, refresh, reuse,
+                (stream, ema, cached),
+            )
+            selected = draw_with_replacement(k_sel, cached.probs, batch_size)
+            scaled_probs = cached.probs[selected] * pool_size
+            sel_raw, sel_labels = gather_train(cached.slots[selected])
+            sel_images = _augment(k_aug2, normalize_images(sel_raw, mean, std))
+            avg_pool_loss = cached.pool_loss
+            new_cached = cached
         else:
             if use_groupwise:
                 # Sliding-window refresh over the shard (util.py:114-138):
@@ -350,17 +423,14 @@ def make_train_step(
                 # Shuffled wrapping presample stream (≡ Trainer.get_next over
                 # the presampling loader, :74-82).
                 stream, slots = next_pool(stream, k_stream, pool_size)
-            raw, labels = gather_train(slots)
-            images = _augment(k_aug, normalize_images(raw, mean, std))
 
             if use_is:
                 # --- importance scoring: ONE batched inference forward over
                 # the pool (≡ the 10-iteration no_grad loop, :95-106),
                 # batch-stat normalization, running-stat updates discarded --
-                pool_logits, _, _ = _apply_train(
-                    state.params, state.batch_stats, images, False
+                images, labels, pool_logits, pool_losses = score_slots(
+                    slots, k_aug
                 )
-                pool_losses = _score_per_sample(pool_logits, labels)
                 if use_groupwise:
                     # Persist scores into the shard-wide importance array,
                     # tag the new generation, draw from it with the +mean
@@ -391,9 +461,13 @@ def make_train_step(
                 # Uniform baseline: consume the freshly streamed batch
                 # directly — the stream is a shuffled without-replacement
                 # epoch pass, i.e. standard shuffled-loader SGD — with unit
-                # IS weights so loss/(N·p) = loss.
-                sel_images = images[:batch_size]
-                sel_labels = labels[:batch_size]
+                # IS weights so loss/(N·p) = loss. (pool_size == batch_size
+                # here, so no scoring forward and no wasted gather.)
+                raw, sel_labels = gather_train(slots)
+                sel_images = _augment(
+                    k_aug, normalize_images(raw, mean, std)
+                )[:batch_size]
+                sel_labels = sel_labels[:batch_size]
                 scaled_probs = jnp.ones((batch_size,), jnp.float32)
                 avg_pool_loss = jnp.zeros((), jnp.float32)
 
@@ -501,6 +575,10 @@ def make_train_step(
                 jax.tree_util.tree_map(lambda x: x[None], new_pending)
                 if pipelined else state.pending
             ),
+            cached_pool=(
+                jax.tree_util.tree_map(lambda x: x[None], new_cached)
+                if use_cadence else state.cached_pool
+            ),
         )
         metrics = {
             "train/loss": loss_mean,
@@ -523,7 +601,8 @@ def make_train_step(
         fn = body
 
     specs = _state_specs(axis, has_groupwise=use_groupwise,
-                         has_pending=pipelined, zero_sharding=zero)
+                         has_pending=pipelined, zero_sharding=zero,
+                         has_cached_pool=use_cadence)
     smap_kw = {}
     if auto_axes:
         # Manual over the data axis only; GSPMD handles the rest.

@@ -108,12 +108,6 @@ class Trainer:
                     "tensor_parallel requires the transformer family "
                     f"(model='transformer'|'vit'), got {config.model!r}"
                 )
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "tensor_parallel > 1 is single-controller only for "
-                    "now: the TP placement targets the full mesh, which "
-                    "globalize_state's replicated re-placement would undo"
-                )
             if config.model_axis not in self.mesh.axis_names or (
                 self.mesh.shape[config.model_axis] != tp
             ):
@@ -200,6 +194,13 @@ class Trainer:
                                   else sample_shape),
             zero_sharding=config.zero_sharding,
             init_opt=(tp == 1),
+            cached_pool_size=(
+                config.candidate_pool_size
+                if config.use_importance_sampling
+                and config.sampler == "pool"
+                and config.score_refresh_every > 1
+                else 0
+            ),
         )
         if tp > 1:
             # Commit params in the Megatron column/row TP layout and
@@ -217,42 +218,22 @@ class Trainer:
                 )
             param_sh = transformer_tp_shardings(self.state.params, self.mesh,
                                                 config.model_axis)
-            tp_params = jax.device_put(self.state.params, param_sh)
-            # create_state skipped tx.init (init_opt=False): the single
-            # init below inherits the TP layout via zeros_like — no
-            # transient replicated moment tree.
-            tp_opt = self.tx.init(tp_params)
-            self.state = self.state.replace(params=tp_params, opt_state=tp_opt)
-            # Moments inherit their param's layout from init-by-zeros_like;
-            # scalar leaves (step counts) come back single-device committed
-            # and must be normalized to mesh-replicated before use as
-            # output constraints.
-            from jax.sharding import NamedSharding, PartitionSpec as Pspec
-
-            def norm_sh(leaf):
-                s = getattr(leaf, "sharding", None)
-                if isinstance(s, NamedSharding) and s.mesh == self.mesh:
-                    return s
-                return NamedSharding(self.mesh, Pspec())
-
-            opt_sh = jax.tree_util.tree_map(norm_sh, tp_opt)
-            from mercury_tpu.train.step import mercury_state_out_shardings
-
-            self._state_out_shardings = mercury_state_out_shardings(
-                self.mesh, config.mesh_axis, param_sh, opt_sh,
-                has_groupwise=(config.use_importance_sampling
-                               and config.sampler == "groupwise"),
-                has_pending=(config.use_importance_sampling
-                             and config.pipelined_scoring),
-            )
-            # Pre-place the whole state with the pinned shardings (a
-            # no-copy no-op for the already-committed params/opt): the
-            # first step then donates cleanly instead of warning about
-            # unusable host-resident sampler buffers and resharding on
-            # entry. device_put accepts the prefix sharding pytree, so
-            # groupwise/pending subtrees are covered too.
-            state_sh, _ = self._state_out_shardings
-            self.state = jax.device_put(self.state, state_sh)
+            if jax.process_count() == 1:
+                tp_params = jax.device_put(self.state.params, param_sh)
+                # create_state skipped tx.init (init_opt=False): the single
+                # init below inherits the TP layout via zeros_like — no
+                # transient replicated moment tree.
+                tp_opt = self.tx.init(tp_params)
+                self.state = self.state.replace(params=tp_params,
+                                                opt_state=tp_opt)
+            else:
+                # Multi-controller: device_put cannot target other hosts'
+                # devices — the TP placement happens inside
+                # globalize_state below (params_sharding=param_sh), and
+                # the optimizer init runs as an SPMD program on the placed
+                # params afterwards.
+                tp_opt = None
+            self._tp_param_sh = param_sh
         else:
             self._state_out_shardings = None
         # Multi-controller (multi-host) runs: the host-created state and
@@ -281,12 +262,71 @@ class Trainer:
                 globalize_state,
             )
 
-            self.state = globalize_state(self.state, self.mesh, config.mesh_axis,
-                                         zero_sharding=config.zero_sharding)
+            self.state = globalize_state(
+                self.state, self.mesh, config.mesh_axis,
+                zero_sharding=config.zero_sharding,
+                params_sharding=(self._tp_param_sh if tp > 1 else None),
+            )
+            if tp > 1:
+                # SPMD optimizer init on the TP-placed params, with the
+                # moment layout pinned explicitly (opt_sharding_like):
+                # zeros_like gives the partitioner no constraint to
+                # propagate, so an unpinned init can come back replicated
+                # — which would alias-clash with the TP-sharded step
+                # outputs on the first donated call.
+                from mercury_tpu.parallel.tensor import opt_sharding_like
+
+                opt_shapes = jax.eval_shape(self.tx.init, self.state.params)
+                self._tp_opt_sh = opt_sharding_like(
+                    opt_shapes, self.state.params, self._tp_param_sh,
+                    self.mesh,
+                )
+                tp_opt = jax.jit(
+                    self.tx.init, out_shardings=self._tp_opt_sh
+                )(self.state.params)
+                self.state = self.state.replace(opt_state=tp_opt)
             self.dataset = globalize_dataset(
                 self.dataset, self.mesh, config.mesh_axis,
                 include_train_arrays=not data_sharded,
             )
+        if tp > 1:
+            # The moment layout is DERIVED (opt_sharding_like), not
+            # inferred from live leaves: the structural param-path match
+            # is exact for optax states, where sharding inference from a
+            # jitted init's outputs is backend-dependent. The multi-
+            # controller branch above already computed it; compute here
+            # only on the single-process path.
+            if getattr(self, "_tp_opt_sh", None) is None:
+                from mercury_tpu.parallel.tensor import opt_sharding_like
+
+                self._tp_opt_sh = opt_sharding_like(
+                    self.state.opt_state, self.state.params,
+                    self._tp_param_sh, self.mesh,
+                )
+            opt_sh = self._tp_opt_sh
+            from mercury_tpu.train.step import mercury_state_out_shardings
+
+            self._state_out_shardings = mercury_state_out_shardings(
+                self.mesh, config.mesh_axis, self._tp_param_sh, opt_sh,
+                has_groupwise=(config.use_importance_sampling
+                               and config.sampler == "groupwise"),
+                has_pending=(config.use_importance_sampling
+                             and config.pipelined_scoring),
+                has_cached_pool=(config.use_importance_sampling
+                                 and config.sampler == "pool"
+                                 and config.score_refresh_every > 1),
+            )
+            if jax.process_count() == 1:
+                # Pre-place the whole state with the pinned shardings (a
+                # no-copy no-op for the already-committed params/opt): the
+                # first step then donates cleanly instead of warning about
+                # unusable host-resident sampler buffers and resharding on
+                # entry. device_put accepts the prefix sharding pytree, so
+                # groupwise/pending subtrees are covered too. (Multi-
+                # controller state is already fully placed by
+                # globalize_state.)
+                state_sh, _ = self._state_out_shardings
+                self.state = jax.device_put(self.state, state_sh)
         if not data_sharded:
             self._step_x = self.dataset.x_train
             self._step_y = self.dataset.y_train
@@ -585,28 +625,52 @@ class Trainer:
         assert directory, "no checkpoint directory configured"
         return ckpt.save_checkpoint(directory, self.state, int(self.state.step))
 
-    def restore(self, directory: Optional[str] = None, step: Optional[int] = None) -> int:
-        directory = directory or self.config.checkpoint_dir
-        assert directory, "no checkpoint directory configured"
-        self.state, step = ckpt.restore_checkpoint(directory, self.state, step)
+    def _recommit_state(self) -> None:
+        """Re-place a host-resident ``self.state`` for this trainer's
+        topology: global arrays over the cross-process mesh
+        (multi-controller), and/or the committed Megatron TP layout —
+        so the first post-restore step hits the jit cache (the input
+        sharding signature is part of it) and the layout-stability
+        invariant holds from step one. Shared by ``restore`` and
+        ``restore_elastic``."""
         if jax.process_count() > 1:
-            # restore_checkpoint returns host-resident arrays; re-place them
-            # as global arrays over the cross-process mesh.
             from mercury_tpu.parallel.distributed import globalize_state
 
+            tp_kw = {}
+            if self._state_out_shardings is not None:
+                state_sh, _ = self._state_out_shardings
+                tp_kw = dict(params_sharding=state_sh.params,
+                             opt_sharding=state_sh.opt_state)
             self.state = globalize_state(
                 self.state, self.mesh, self.config.mesh_axis,
-                zero_sharding=self.config.zero_sharding,
+                zero_sharding=self.config.zero_sharding, **tp_kw,
             )
         elif self._state_out_shardings is not None:
-            # TP: restore_checkpoint returned host-resident arrays — re-
-            # commit the Megatron layout so the first post-resume step hits
-            # the jit cache (the input sharding signature is part of it)
-            # and the layout-stability invariant holds from step one.
             state_sh, _ = self._state_out_shardings
             self.state = self.state.replace(
                 params=jax.device_put(self.state.params, state_sh.params),
                 opt_state=jax.device_put(self.state.opt_state,
                                          state_sh.opt_state),
             )
+
+    def restore_elastic(self, directory: Optional[str] = None,
+                        step: Optional[int] = None) -> int:
+        """Restore a checkpoint saved at a DIFFERENT world size: model and
+        optimizer state transfer exactly (ZeRO-1 chunks reshard W→W′);
+        per-worker sampler state re-derives for the new topology. See
+        ``mercury_tpu.train.elastic``. The reference hangs on any topology
+        change (``pytorch_collab.py:291-292``)."""
+        from mercury_tpu.train.elastic import elastic_restore
+
+        directory = directory or self.config.checkpoint_dir
+        assert directory, "no checkpoint directory configured"
+        step = elastic_restore(directory, self, step)
+        self._recommit_state()
+        return step
+
+    def restore(self, directory: Optional[str] = None, step: Optional[int] = None) -> int:
+        directory = directory or self.config.checkpoint_dir
+        assert directory, "no checkpoint directory configured"
+        self.state, step = ckpt.restore_checkpoint(directory, self.state, step)
+        self._recommit_state()
         return step

@@ -152,10 +152,54 @@ def main(port: str, pid: int) -> None:
         sl = float(ms["train/loss"])
     assert sl == losses[-1], (sl, losses[-1])
 
+    # 8. dp×tp multi-controller: 4-way data × 2-way tensor parallelism
+    #    over the same 2-process cluster. globalize_state places the
+    #    params in the committed Megatron layout (params_sharding) and the
+    #    optimizer init runs SPMD on the placed params — the fused IS step
+    #    then runs with every transformer matmul TP-sharded ACROSS the
+    #    process boundary (VERDICT round-2 item 6).
+    cfg_tp = TrainConfig(
+        model="transformer", dataset="synthetic_seq", augmentation="none",
+        world_size=4, tensor_parallel=2, batch_size=4, presample_batches=2,
+        steps_per_epoch=2, num_epochs=1, eval_every=0, log_every=0,
+        compute_dtype="float32", seed=0,
+    )
+    trainer_tp = Trainer(cfg_tp)  # builds the global dp×tp mesh itself
+    assert trainer_tp.mesh.shape == {"data": 4, "model": 2}
+    # The Megatron split must be real on-device: a model-axis-sharded leaf's
+    # per-device shard holds half the parameter.
+    def model_split(l):
+        return any(
+            ax == "model" or (isinstance(ax, tuple) and "model" in ax)
+            for ax in l.sharding.spec if ax is not None
+        )
+
+    tp_leaf = next(
+        l for l in jax.tree_util.tree_leaves(trainer_tp.state.params)
+        if model_split(l)
+    )
+    shard_bytes = tp_leaf.addressable_shards[0].data.nbytes
+    assert shard_bytes * 2 == tp_leaf.nbytes, (shard_bytes, tp_leaf.nbytes)
+    tl = None
+    for _ in range(2):
+        trainer_tp.state, mt = trainer_tp.train_step(
+            trainer_tp.state, trainer_tp.dataset.x_train,
+            trainer_tp.dataset.y_train, trainer_tp.dataset.shard_indices,
+        )
+        tl = float(mt["train/loss"])
+    assert np.isfinite(tl), tl
+    # The out-shardings pin must hold across the process boundary too.
+    leaf_after = next(
+        l for l in jax.tree_util.tree_leaves(trainer_tp.state.params)
+        if model_split(l)
+    )
+    assert leaf_after.addressable_shards[0].data.nbytes * 2 == leaf_after.nbytes
+
     # Full precision (hex) so the cross-process comparison is bit-for-bit.
     print(f"OK {psum_val} {pmean_val} {mine.tolist()} "
           f"loss={losses[-1].hex()} post={post.hex()} zero={zloss.hex()} "
-          f"sharded={sl.hex()} sharded_frac={local_bytes/full_bytes:.3f}",
+          f"sharded={sl.hex()} sharded_frac={local_bytes/full_bytes:.3f} "
+          f"tp={tl.hex()}",
           flush=True)
 
 

@@ -1,0 +1,134 @@
+"""Elastic resume: restore into a different world size
+(``mercury_tpu/train/elastic.py``). The reference hangs forever on any
+topology change (``pytorch_collab.py:291-292`` — gloo collectives block on
+the lost worker); surviving W→W′ is the beyond-parity bar from the
+round-2 verdict."""
+
+import jax
+import numpy as np
+import pytest
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.parallel.mesh import host_cpu_mesh
+from mercury_tpu.train.trainer import Trainer
+
+
+def cfg(world, **kw):
+    base = dict(
+        model="smallcnn",
+        dataset="synthetic",
+        world_size=world,
+        batch_size=8,
+        presample_batches=2,
+        num_epochs=1,
+        steps_per_epoch=4,
+        eval_every=0,
+        log_every=0,
+        compute_dtype="float32",
+        seed=0,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def run_steps(t, n):
+    m = None
+    for _ in range(n):
+        t.state, m = t.train_step(
+            t.state, t._step_x, t._step_y, t.dataset.shard_indices
+        )
+    return m
+
+
+class TestElasticResume:
+    @pytest.mark.parametrize("w_old,w_new", [(4, 8), (8, 4)])
+    def test_grow_and_shrink(self, tmp_path, w_old, w_new):
+        """Train W-way, checkpoint, resume W′-way: params/opt transfer
+        exactly, step continues, and the loss trajectory stays sane."""
+        t1 = Trainer(cfg(w_old, checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(w_old))
+        losses_before = [float(run_steps(t1, 1)["train/loss"])
+                         for _ in range(5)]
+        t1.save()
+        want_params = jax.tree_util.tree_leaves(t1.state.params)
+
+        t2 = Trainer(cfg(w_new, checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(w_new))
+        step = t2.restore_elastic()
+        assert step == 5
+        assert int(t2.state.step) == 5
+        got_params = jax.tree_util.tree_leaves(t2.state.params)
+        for a, b in zip(want_params, got_params):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # EMA warm start: carried value/count, broadcast to the new W.
+        assert t2.state.ema.value.shape == (w_new,)
+        np.testing.assert_allclose(
+            np.asarray(t2.state.ema.value),
+            float(np.mean(np.asarray(t1.state.ema.value))), rtol=1e-6,
+        )
+        assert int(np.asarray(t2.state.ema.count).min()) == 5
+        # Continued training is sane: finite losses in the ballpark of the
+        # pre-resume trajectory (not a re-divergence to init loss).
+        losses_after = [float(run_steps(t2, 1)["train/loss"])
+                        for _ in range(5)]
+        assert all(np.isfinite(l) for l in losses_after)
+        assert np.mean(losses_after) < losses_before[0] + 0.5, (
+            losses_before, losses_after,
+        )
+
+    def test_zero_sharding_moments_transfer_exactly(self, tmp_path):
+        """ZeRO-1 chunk resharding W=4 → W′=8 is exact: the re-chunked
+        moment vectors equal the originals element-for-element."""
+        from mercury_tpu.utils.tree import tree_flatten_to_vector
+
+        t1 = Trainer(cfg(4, zero_sharding=True,
+                         checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(4))
+        run_steps(t1, 3)
+        t1.save()
+        pvec, _ = tree_flatten_to_vector(t1.state.params)
+        n_params = int(pvec.size)
+
+        def flat_moments(state, w):
+            # [W, C] chunk leaves → the first n_params entries of the
+            # concatenated vector (the rest is padding).
+            out = []
+            for leaf in jax.tree_util.tree_leaves(state.opt_state):
+                a = np.asarray(leaf)
+                if a.ndim >= 2 and a.shape[0] == w:
+                    out.append(a.reshape(w * a.shape[1], -1)[:n_params])
+            return out
+
+        want = flat_moments(t1.state, 4)
+        t2 = Trainer(cfg(8, zero_sharding=True,
+                         checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(8))
+        t2.restore_elastic()
+        got = flat_moments(t2.state, 8)
+        assert len(want) == len(got) and len(want) >= 2  # adam mu and nu
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
+        m = run_steps(t2, 2)
+        assert np.isfinite(float(m["train/loss"]))
+
+    def test_same_world_size_passthrough(self, tmp_path):
+        """W′ == W elastic restore still works (degenerate case)."""
+        t1 = Trainer(cfg(4, checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(4))
+        run_steps(t1, 2)
+        t1.save()
+        t2 = Trainer(cfg(4, checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(4))
+        assert t2.restore_elastic() == 2
+        m = run_steps(t2, 1)
+        assert np.isfinite(float(m["train/loss"]))
+
+    def test_model_mismatch_rejected(self, tmp_path):
+        t1 = Trainer(cfg(4, checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(4))
+        run_steps(t1, 1)
+        t1.save()
+        t2 = Trainer(cfg(4, model="resnet18", checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(4))
+        with pytest.raises(Exception):
+            t2.restore_elastic()

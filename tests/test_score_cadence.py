@@ -1,0 +1,149 @@
+"""Score-refresh cadence (``config.score_refresh_every = K``): the scored
+candidate pool is refreshed every K-th step and the steps in between redraw
+from the cached distribution — amortizing the pool-scoring forward, the
+dominant per-step IS cost (the reference pays it every step,
+``pytorch_collab.py:95-106``), by K."""
+
+import numpy as np
+import pytest
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.parallel.mesh import host_cpu_mesh
+from mercury_tpu.train.trainer import Trainer
+
+
+def cadence_config(**kw) -> TrainConfig:
+    base = dict(
+        model="smallcnn",
+        dataset="synthetic",
+        world_size=8,
+        batch_size=8,
+        presample_batches=3,
+        num_epochs=1,
+        steps_per_epoch=6,
+        eval_every=0,
+        log_every=0,
+        compute_dtype="float32",
+        seed=0,
+        score_refresh_every=3,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return host_cpu_mesh(8)
+
+
+class TestScoreCadence:
+    def test_trains_and_loss_decreases(self, mesh):
+        t = Trainer(cadence_config(num_epochs=3), mesh=mesh)
+        first = None
+        for _ in range(12):
+            t.state, metrics = t.train_step(
+                t.state, t._step_x, t._step_y, t.dataset.shard_indices
+            )
+            if first is None:
+                first = float(metrics["train/loss"])
+        last = float(metrics["train/loss"])
+        assert np.isfinite(last)
+        assert last < first
+
+    def test_ema_updates_only_on_refresh_steps(self, mesh):
+        t = Trainer(cadence_config(), mesh=mesh)
+        for _ in range(6):  # steps 0..5, K=3 → refreshes at steps 0 and 3
+            t.state, _ = t.train_step(
+                t.state, t._step_x, t._step_y, t.dataset.shard_indices
+            )
+        assert int(np.asarray(t.state.ema.count).max()) == 2
+
+    def test_stream_advances_only_on_refresh_steps(self, mesh):
+        t = Trainer(cadence_config(), mesh=mesh)
+        pool = t.config.candidate_pool_size
+        for _ in range(5):  # refreshes at steps 0 and 3
+            t.state, _ = t.train_step(
+                t.state, t._step_x, t._step_y, t.dataset.shard_indices
+            )
+        cursors = np.asarray(t.state.stream.cursor)
+        shard_len = int(t.dataset.shard_indices.shape[1])
+        assert (cursors % shard_len == (2 * pool) % shard_len).all()
+
+    def test_cached_pool_is_valid_distribution(self, mesh):
+        t = Trainer(cadence_config(), mesh=mesh)
+        t.state, _ = t.train_step(
+            t.state, t._step_x, t._step_y, t.dataset.shard_indices
+        )
+        probs = np.asarray(t.state.cached_pool.probs)
+        assert probs.shape == (8, t.config.candidate_pool_size)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+        assert (probs >= 0).all()
+
+    def test_cadence_one_keeps_reference_path(self, mesh):
+        """K=1 must be the untouched pre-feature path: no cache in the
+        state (its presence would change donation/jit signatures), no
+        cadence arm in the step program, and the EMA updating every step
+        (the cadence arm updates it only on refreshes)."""
+        from mercury_tpu.train.step import _state_specs
+
+        t = Trainer(cadence_config(score_refresh_every=1), mesh=mesh)
+        assert t.state.cached_pool is None
+        assert _state_specs("data").cached_pool is None
+        for _ in range(3):
+            t.state, _ = t.train_step(
+                t.state, t._step_x, t._step_y, t.dataset.shard_indices
+            )
+        assert t.state.cached_pool is None
+        # Every step refreshed (EMA count 3) — under K=3 this is 1.
+        assert int(np.asarray(t.state.ema.count).max()) == 3
+
+    def test_checkpoint_roundtrip_is_deterministic(self, mesh, tmp_path):
+        """The cached pool is part of the state pytree: save mid-cadence
+        (between refreshes), restore, and the continued trajectory is
+        bit-identical."""
+        cfg = cadence_config(checkpoint_dir=str(tmp_path), checkpoint_every=0)
+        t = Trainer(cfg, mesh=mesh)
+        for _ in range(4):  # stop mid-window (last refresh at step 3)
+            t.state, _ = t.train_step(
+                t.state, t._step_x, t._step_y, t.dataset.shard_indices
+            )
+        t.save()
+        for _ in range(3):
+            t.state, _ = t.train_step(
+                t.state, t._step_x, t._step_y, t.dataset.shard_indices
+            )
+        import jax
+
+        want = np.asarray(jax.tree_util.tree_leaves(t.state.params)[0])
+
+        t2 = Trainer(cfg, mesh=mesh)
+        t2.restore()
+        assert int(t2.state.step) == 4
+        np.testing.assert_array_equal(
+            np.asarray(t2.state.cached_pool.slots).shape,
+            (8, cfg.candidate_pool_size),
+        )
+        for _ in range(3):
+            t2.state, _ = t2.train_step(
+                t2.state, t2._step_x, t2._step_y, t2.dataset.shard_indices
+            )
+        got = np.asarray(jax.tree_util.tree_leaves(t2.state.params)[0])
+        np.testing.assert_array_equal(want, got)
+
+    def test_rejects_bad_compositions(self, mesh):
+        with pytest.raises(ValueError, match="groupwise"):
+            Trainer(cadence_config(sampler="groupwise"), mesh=mesh)
+        with pytest.raises(ValueError, match="pipelined"):
+            Trainer(cadence_config(pipelined_scoring=True), mesh=mesh)
+        with pytest.raises(ValueError, match=">= 1"):
+            Trainer(cadence_config(score_refresh_every=0), mesh=mesh)
+
+    def test_scan_steps_compose(self, mesh):
+        """Cadence inside a scanned chunk: lax.cond under lax.scan."""
+        t = Trainer(cadence_config(scan_steps=3, num_epochs=2), mesh=mesh)
+        t.state, metrics = t.train_step_many(
+            t.state, t._step_x, t._step_y, t.dataset.shard_indices
+        )
+        assert int(t.state.step) == 3
+        assert np.isfinite(np.asarray(metrics["train/loss"])).all()
+        assert int(np.asarray(t.state.ema.count).max()) == 1
