@@ -18,3 +18,17 @@ sys.path.insert(
 from mercury_tpu.platform import select_cpu_if_requested  # noqa: E402
 
 select_cpu_if_requested()
+
+# Persistent compile cache: multi-arm benchmarks recompile near-identical
+# programs per arm/seed; on the tunneled chip each compile is a slow remote
+# round trip — cache them like bench.py and the test harness do.
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir,
+                                     ".jax_cache")),
+    ),
+)

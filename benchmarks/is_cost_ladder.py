@@ -1,0 +1,145 @@
+"""The IS cost ladder: what each Mercury knob buys back of the uniform-SGD
+throughput, measured on one chip.
+
+Round 2 measured the flagship cost honestly: scoring a 10× candidate pool
+every step prices importance sampling at ~2.6× a uniform step on the real
+chip (BENCH vs_baseline 0.384). This ladder measures the three cost levers
+against that bill (reference candidate-pool semantics:
+``pytorch_collab.py:95-117``):
+
+- ``score_refresh_every=K``: the scoring forward runs every K-th step
+  (steps between redraw from the cached distribution) — amortizes the
+  dominant cost by K;
+- ``presample_batches=P``: pool size P× batch — scales the scoring
+  forward's width;
+- ``pipelined_scoring``: overlaps the scoring forward with the gradient
+  path (XLA schedules the independent chains concurrently).
+
+Usage::
+
+    python benchmarks/is_cost_ladder.py [--steps 30] [--scan 25]
+
+Appends one JSON record to ``benchmarks/results_is_cost_ladder.jsonl``
+with images/sec for every arm and its ratio to uniform.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
+
+import numpy as np  # noqa: E402
+
+
+def build(args, scan_steps, **overrides):
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        model=args.model,
+        dataset="synthetic",
+        world_size=1,
+        batch_size=args.batch_size,
+        steps_per_epoch=args.steps * args.scan_calls * scan_steps + 64,
+        num_epochs=1,
+        eval_every=0,
+        log_every=0,
+        scan_steps=scan_steps,
+        seed=0,
+        **overrides,
+    )
+    return Trainer(config, mesh=make_mesh(1, config.mesh_axis))
+
+
+def measure(trainer, args) -> float:
+    """images/sec over scan-chunked dispatches, host-fetch fenced (same
+    protocol as bench.py's bench_fused)."""
+    ds = trainer.dataset
+    state = trainer.state
+    step_fn = trainer.train_step_many or trainer.train_step
+    k = trainer.scan_steps
+    calls = args.scan_calls if k > 1 else args.steps
+    for _ in range(3):
+        state, metrics = step_fn(state, ds.x_train, ds.y_train,
+                                 ds.shard_indices)
+        np.asarray(metrics["train/loss"])
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        state, metrics = step_fn(state, ds.x_train, ds.y_train,
+                                 ds.shard_indices)
+    np.asarray(metrics["train/loss"])
+    dt = time.perf_counter() - t0
+    trainer.state = state
+    return args.batch_size * calls * k / dt
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--scan", type=int, default=25)
+    ap.add_argument("--scan-calls", type=int, default=8)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "results_is_cost_ladder.jsonl"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"# platform {dev.platform} / {dev.device_kind}", file=sys.stderr)
+
+    arms = [
+        ("uniform", {"use_importance_sampling": False}),
+        ("is_pool10_k1", {"presample_batches": 10}),
+        ("is_pool10_k2", {"presample_batches": 10, "score_refresh_every": 2}),
+        ("is_pool10_k4", {"presample_batches": 10, "score_refresh_every": 4}),
+        ("is_pool10_k8", {"presample_batches": 10, "score_refresh_every": 8}),
+        ("is_pool4_k1", {"presample_batches": 4}),
+        ("is_pool4_k4", {"presample_batches": 4, "score_refresh_every": 4}),
+        ("is_pool2_k1", {"presample_batches": 2}),
+        ("is_pool10_pipelined", {"presample_batches": 10,
+                                 "pipelined_scoring": True}),
+    ]
+    results = {}
+    for label, overrides in arms:
+        try:
+            trainer = build(args, args.scan, **overrides)
+            ips = measure(trainer, args)
+            del trainer
+        except Exception as e:  # one arm must not kill the ladder
+            print(f"# arm {label} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            ips = None
+        results[label] = round(ips, 1) if ips else None
+        print(f"# {label}: {results[label]} img/s", file=sys.stderr)
+
+    uniform = results.get("uniform") or float("nan")
+    record = {
+        "schema": "is_cost_ladder_v1",
+        "model": args.model,
+        "batch_size": args.batch_size,
+        "scan_steps": args.scan,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "images_per_sec": results,
+        "vs_uniform": {
+            label: (round(v / uniform, 3) if v else None)
+            for label, v in results.items()
+        },
+    }
+    with open(args.out, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,7 +49,8 @@ def run_arm(label: str, args, seed: int, **overrides) -> dict:
 
     n_dev = len(jax.devices())
     world = min(args.world_size, n_dev)
-    config = TrainConfig(
+    scan = max(int(getattr(args, "scan", 1)), 1)
+    base_kw = dict(
         model=args.model,
         dataset=args.dataset,
         world_size=world,
@@ -61,28 +62,47 @@ def run_arm(label: str, args, seed: int, **overrides) -> dict:
         log_every=0,
         compute_dtype=args.compute_dtype,
         seed=seed,
-        **overrides,
+        scan_steps=scan,
     )
+    if args.dataset == "digits":
+        # Handwritten digits: horizontal flips/crops destroy class
+        # identity (6 vs 9); normalize-only is the honest pipeline.
+        base_kw["augmentation"] = "none"
+    base_kw.update(overrides)  # arm overrides win (e.g. a smaller pool)
+    config = TrainConfig(**base_kw)
     trainer = Trainer(config, mesh=make_mesh(world, config.mesh_axis))
     ds = trainer.dataset
+
+    def advance(n):
+        """n steps (n % scan == 0 → chunked dispatches — essential when
+        per-dispatch latency rivals compute, e.g. a tunneled chip)."""
+        m = None
+        many, one = trainer.train_step_many, trainer.train_step
+        left = n
+        while left >= scan and many is not None:
+            trainer.state, m = many(
+                trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+            left -= scan
+        for _ in range(left):
+            trainer.state, m = one(
+                trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+        return m
+
     trajectory = []
-    # First step outside the timer: it carries the XLA compile, which
+    # First dispatch outside the timer: it carries the XLA compile, which
     # would otherwise be charged to this arm's time-to-target.
-    trainer.state, m = trainer.train_step(
-        trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+    m = advance(scan)
     np.asarray(m["train/loss"])
-    step = 1
+    step = scan
     train_s = 0.0
     while step < args.steps:
-        # Next eval boundary (the compile step already advanced us to 1).
+        # Next eval boundary (the compile dispatch already advanced us).
         boundary = min(((step // args.eval_every) + 1) * args.eval_every,
                        args.steps)
         n = boundary - step
         t0 = time.perf_counter()
-        for _ in range(n):
-            trainer.state, m = trainer.train_step(
-                trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
-            step += 1
+        m = advance(n)
+        step += n
         np.asarray(m["train/loss"])  # device fence before stopping the clock
         train_s += time.perf_counter() - t0
         acc = trainer.evaluate(include_train=False)["test/eval_acc"]
@@ -115,19 +135,47 @@ def main(argv=None) -> int:
     ap.add_argument("--target-acc", type=float, default=0.85)
     ap.add_argument("--seeds", type=int, default=3)
     ap.add_argument("--compute-dtype", default="float32")
+    ap.add_argument("--arms", default=None,
+                    help="comma-separated arm subset (default: the "
+                         "original three)")
+    ap.add_argument("--scan", type=int, default=1,
+                    help="fuse this many steps per dispatch (use "
+                         "eval_every's divisor on tunneled chips)")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "results_sample_efficiency.jsonl"))
     args = ap.parse_args(argv)
     if args.steps < 2:
         ap.error("--steps must be >= 2 (step 1 is the untimed compile step)")
+    if args.scan > 1 and (args.eval_every % args.scan
+                          or args.steps % args.scan):
+        # A non-dividing scan would fall back to the single-step program
+        # mid-measurement, charging ITS compile inside a timed window.
+        ap.error("--scan must divide both --eval-every and --steps")
 
-    # Three arms: the reference's loss score, the Katharopoulos-Fleuret
-    # gradient-norm score, and the uniform control.
-    arm_defs = [
+    # Arms: the reference's loss score, the Katharopoulos-Fleuret
+    # gradient-norm score, the uniform control — plus the round-3 cost
+    # levers (score-refresh cadence K amortizes the pool-scoring forward,
+    # smaller pools shrink it; the throughput side of each is measured in
+    # is_cost_ladder.py, this measures what the staleness costs in
+    # convergence). Select a subset with --arms.
+    all_arm_defs = [
         ("is_loss", {}),
         ("is_grad_norm", {"importance_score": "grad_norm"}),
         ("uniform", {"use_importance_sampling": False}),
+        ("is_k4", {"score_refresh_every": 4}),
+        ("is_k8", {"score_refresh_every": 8}),
+        ("is_pool4_k4", {"presample_batches": 4, "score_refresh_every": 4}),
+        ("is_grad_norm_k4", {"importance_score": "grad_norm",
+                             "score_refresh_every": 4}),
     ]
+    if args.arms:
+        wanted = args.arms.split(",")
+        unknown = set(wanted) - {l for l, _ in all_arm_defs}
+        if unknown:
+            ap.error(f"unknown arms: {sorted(unknown)}")
+        arm_defs = [(l, ov) for l, ov in all_arm_defs if l in wanted]
+    else:
+        arm_defs = all_arm_defs[:3]
     per_seed = []
     for seed in range(args.seeds):
         arms = {

@@ -246,6 +246,39 @@ def load_dataset(
             "synthetic": True,
         }
 
+    if name == "digits":
+        # The one REAL image dataset guaranteed on disk in a sealed
+        # environment: scikit-learn's bundled handwritten-digits set
+        # (UCI ML Optical Recognition of Handwritten Digits — 1,797 real
+        # 8×8 grayscale scans, shipped inside the sklearn wheel, no
+        # download). Small, but its signal is real: the north-star
+        # time-to-target comparison (BASELINE.md rows 1-3) runs on it
+        # with honest provenance when CIFAR bytes are absent. Upscaled
+        # to 32×32×3 so the CIFAR-shaped models/augmentation apply
+        # unchanged; split 80/20 deterministically in ``seed``.
+        from sklearn.datasets import load_digits as _load_digits
+
+        d = _load_digits()
+        imgs = (d.images / d.images.max() * 255.0).astype(np.uint8)
+        imgs = np.repeat(np.repeat(imgs, 4, axis=1), 4, axis=2)  # 8→32
+        imgs = np.repeat(imgs[..., None], 3, axis=-1)            # gray→RGB
+        labels = d.target.astype(np.int32)
+        rng_d = np.random.default_rng(seed)
+        order = rng_d.permutation(len(imgs))
+        n_test = len(imgs) // 5
+        test_idx, train_idx = order[:n_test], order[n_test:]
+        train = (imgs[train_idx], labels[train_idx])
+        test = (imgs[test_idx], labels[test_idx])
+        flat = imgs[train_idx].astype(np.float32) / 255.0
+        mean = flat.mean(axis=(0, 1, 2)).astype(np.float32)
+        std = np.maximum(flat.std(axis=(0, 1, 2)), 1e-3).astype(np.float32)
+        return train, test, {
+            "num_classes": 10,
+            "mean": mean,
+            "std": std,
+            "synthetic": False,
+        }
+
     if name == "synthetic_seq":
         num_classes = 10
         train, test = synthetic_sequences(
