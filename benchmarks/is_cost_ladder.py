@@ -120,7 +120,8 @@ def main(argv=None) -> int:
         results[label] = round(ips, 1) if ips else None
         print(f"# {label}: {results[label]} img/s", file=sys.stderr)
 
-    uniform = results.get("uniform") or float("nan")
+    uniform = results.get("uniform")  # None if the arm failed — ratios
+    # become None too (NaN would render the whole jsonl line unparseable).
     record = {
         "schema": "is_cost_ladder_v1",
         "model": args.model,
@@ -131,7 +132,7 @@ def main(argv=None) -> int:
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "images_per_sec": results,
         "vs_uniform": {
-            label: (round(v / uniform, 3) if v else None)
+            label: (round(v / uniform, 3) if (v and uniform) else None)
             for label, v in results.items()
         },
     }

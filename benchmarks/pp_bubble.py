@@ -97,25 +97,46 @@ def main(argv=None) -> int:
         print(f"# M={m}: {dt*1000:.1f} ms (model bubble "
               f"{(s-1)/(m+s-1):.2%})", file=sys.stderr)
 
-    # Fit: does T(M) track T_ideal·(M+S-1)/M? Estimate T_ideal from the
-    # largest M, then report measured-vs-model overhead per row.
-    t_big = rows[-1]["step_ms"] / (1 + (s - 1) / rows[-1]["m"])
-    for r in rows:
-        r["model_ms"] = round(t_big * (r["m"] + s - 1) / r["m"], 2)
+    # Fit: does T(M) track T_ideal·(M+S-1)/M? Least-squares T_ideal over
+    # ALL rows (fitting any single row would make that row's ratio 1.0 by
+    # construction), then report measured-vs-model per row and let the
+    # DATA write the decision.
+    coef = np.array([(r["m"] + s - 1) / r["m"] for r in rows])
+    meas = np.array([r["step_ms"] for r in rows])
+    t_ideal = float(coef @ meas / (coef @ coef))
+    for r, c in zip(rows, coef):
+        r["model_ms"] = round(t_ideal * c, 2)
         r["measured_over_model"] = round(r["step_ms"] / r["model_ms"], 3)
-
+    ratios = np.array([r["measured_over_model"] for r in rows])
+    speedup = rows[0]["step_ms"] / min(r["step_ms"] for r in rows)
+    model_speedup = coef[0] / coef.min()
+    fits = float(np.max(np.abs(np.log(ratios)))) < 0.5  # within ~1.65x
+    if fits:
+        decision = (
+            f"Raising M amortizes the bubble as (M+S-1)/M predicts "
+            f"(measured best-over-M speedup {speedup:.1f}x vs model "
+            f"{model_speedup:.1f}x; per-row measured/model within "
+            f"[{ratios.min():.2f}, {ratios.max():.2f}]). Under JAX AD the "
+            "tick scan's backward already matches 1F1B's tick count and "
+            "remat covers its memory edge, so a schedule rewrite buys "
+            "nothing at equal M on this evidence; raise M instead."
+        )
+    else:
+        decision = (
+            f"Measured step times DEVIATE from the (M+S-1)/M model "
+            f"(per-row measured/model spans [{ratios.min():.2f}, "
+            f"{ratios.max():.2f}]) — the bubble model alone does not "
+            "explain the curve on this platform; re-measure on the target "
+            "chip before ruling a schedule change in or out."
+        )
     record = {
-        "schema": "pp_bubble_v1",
+        "schema": "pp_bubble_v2",
         "stages": s, "layers": args.layers, "batch": args.batch,
         "platform": jax.devices()[0].platform,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "t_ideal_ms": round(t_ideal, 2),
         "rows": rows,
-        "decision": (
-            "GPipe tick-scan + remat: measured step time follows the "
-            "(M+S-1)/M amortization model, so a 1F1B schedule (same tick "
-            "count under JAX AD, memory edge already covered by remat) "
-            "would not reduce step time at equal M; raise M instead."
-        ),
+        "decision": decision,
     }
     with open(args.out, "a") as f:
         f.write(json.dumps(record) + "\n")

@@ -44,27 +44,52 @@ from mercury_tpu.train import checkpoint as ckpt
 from mercury_tpu.train.state import MercuryState
 
 
-def _read_raw_state(directory: str, template: MercuryState,
-                    step: Optional[int] = None) -> Tuple[Any, int]:
-    """Read a checkpoint WITHOUT shape-checking against the template:
-    returns a template-structured tree whose leaves keep their on-disk
-    (old-world) shapes, plus the step. PRNG keys stay as raw uint32 key
-    data (the caller re-derives RNG anyway)."""
+def probe_checkpoint(
+    directory: str, step: Optional[int] = None
+) -> Tuple[Optional[dict], Optional[int]]:
+    """Read the (newest, or ``step``'s) checkpoint's raw state dict once.
+    Returns ``(raw, step)`` or ``(None, None)`` when absent/unreadable.
+    The raw tree can be handed to :func:`elastic_restore` so a resume
+    that probed the world size first does not deserialize the file
+    twice."""
     import flax.serialization
 
     if step is None:
         step = ckpt.latest_step(directory)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
+            return None, None
     path = ckpt._ckpt_path(directory, step)
-    if os.path.isdir(path):
-        ocp = ckpt._orbax()
-        assert ocp is not None, "directory checkpoint needs orbax"
-        raw = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
-        raw = _lists_to_dicts(raw)
-    else:
-        with open(path + ".msgpack", "rb") as f:
-            raw = flax.serialization.msgpack_restore(f.read())
+    try:
+        if os.path.isdir(path):
+            ocp = ckpt._orbax()
+            if ocp is None:
+                return None, None
+            raw = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+            raw = _lists_to_dicts(raw)
+        else:
+            with open(path + ".msgpack", "rb") as f:
+                raw = flax.serialization.msgpack_restore(f.read())
+    except Exception:
+        return None, None
+    return raw, step
+
+
+def _read_raw_state(directory: str, template: MercuryState,
+                    step: Optional[int] = None,
+                    raw: Optional[dict] = None) -> Tuple[Any, int]:
+    """Read a checkpoint WITHOUT shape-checking against the template:
+    returns a template-structured tree whose leaves keep their on-disk
+    (old-world) shapes, plus the step. PRNG keys stay as raw uint32 key
+    data (the caller re-derives RNG anyway). A pre-probed ``raw`` tree
+    (with its ``step``) skips the file read."""
+    import flax.serialization
+
+    if raw is None:
+        raw, step = probe_checkpoint(directory, step)
+        if raw is None:
+            raise FileNotFoundError(
+                f"no readable checkpoint under {directory}"
+            )
     # from_state_dict maps the raw dict back onto the template STRUCTURE
     # without reshaping values — exactly what elastic needs: old-shape
     # leaves inside a navigable MercuryState.
@@ -83,6 +108,18 @@ def _lists_to_dicts(tree: Any) -> Any:
     if isinstance(tree, dict):
         return {k: _lists_to_dicts(v) for k, v in tree.items()}
     return tree
+
+
+def world_size_of_raw(raw: Optional[dict]) -> Optional[int]:
+    """World size a raw checkpoint tree was saved at (the leading dim of
+    the per-worker EMA), or None when unreadable. Lets ``auto_resume``
+    decide between the exact restore and the elastic one BEFORE
+    deserializing into a mismatched template — the msgpack path would
+    otherwise silently accept wrong-shaped leaves."""
+    try:
+        return int(np.shape(raw["ema"]["value"])[0])
+    except Exception:
+        return None
 
 
 def _reshard_zero_opt(old_opt: Any, new_opt: Any, w_old: int, w_new: int,
@@ -129,7 +166,8 @@ def _check_same(old: Any, new: Any, what: str) -> Any:
 
 
 def elastic_restore(directory: str, trainer,
-                    step: Optional[int] = None) -> int:
+                    step: Optional[int] = None,
+                    raw: Optional[dict] = None) -> int:
     """Restore ``directory``'s checkpoint (saved at any world size) into
     ``trainer`` (built at the new world size). Returns the restored step.
 
@@ -150,7 +188,7 @@ def elastic_restore(directory: str, trainer,
     template = ckpt._rewrap_keys(
         live, ckpt._host_gather(ckpt._unwrap_keys(live))
     )
-    old, restored_step = _read_raw_state(directory, template, step)
+    old, restored_step = _read_raw_state(directory, template, step, raw=raw)
     w_old = int(np.shape(old.ema.value)[0])
     w_new = int(np.shape(template.ema.value)[0])
 

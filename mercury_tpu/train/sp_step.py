@@ -51,7 +51,15 @@ def make_dp_sp_train_step(
     Returns ``step(params, opt_state, x, y) → (params, opt_state, loss)``
     with ``x: [B, T, F]`` sharded ``P(data, seq)``, ``y: [B]`` sharded
     ``P(data)``, params/opt state replicated.
+
+    With ``model.sp_impl == "zigzag"`` the step permutes the token axis
+    into :func:`~mercury_tpu.parallel.sequence.zigzag_order` inside the
+    jitted program before sharding — the caller keeps feeding plain
+    sequence-ordered batches, and the balanced causal ring does half the
+    matmul FLOPs per hop. (Classification loss reads the pooled head, so
+    no inverse permutation is needed on the way out.)
     """
+    zigzag = getattr(model, "sp_impl", "ring") == "zigzag"
 
     def local_step(params, opt_state, x, y):
         def loss_fn(p):
@@ -78,4 +86,15 @@ def make_dp_sp_train_step(
         in_specs=(P(), P(), P(data_axis, seq_axis), P(data_axis)),
         out_specs=(P(), P(), P()),
     )
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    if not zigzag:
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
+    from mercury_tpu.parallel.sequence import zigzag_order
+
+    w_seq = mesh.shape[seq_axis]
+
+    def step(params, opt_state, x, y):
+        perm = jnp.asarray(zigzag_order(x.shape[1], w_seq))
+        return sharded(params, opt_state, x[:, perm], y)
+
+    return jax.jit(step, donate_argnums=(0, 1))

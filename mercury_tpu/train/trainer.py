@@ -387,9 +387,33 @@ class Trainer:
         self._auto_resumed = False
         if config.auto_resume and config.checkpoint_dir:
             if ckpt.latest_step(config.checkpoint_dir) is not None:
-                resumed = self.restore()
+                # Topology change (preemption shrank the pod / it grew
+                # back): the checkpoint's world size decides between the
+                # bit-exact restore and the elastic one — checked BEFORE
+                # deserializing into a mismatched template, because the
+                # msgpack path would silently accept wrong-shaped sampler
+                # leaves. The probe's raw tree is handed to the elastic
+                # restore so the file is read once, not twice.
+                from mercury_tpu.train.elastic import (
+                    elastic_restore,
+                    probe_checkpoint,
+                    world_size_of_raw,
+                )
+
+                raw, raw_step = probe_checkpoint(config.checkpoint_dir)
+                w_ckpt = world_size_of_raw(raw)
+                if w_ckpt is not None and w_ckpt != config.world_size:
+                    resumed = elastic_restore(
+                        config.checkpoint_dir, self, step=raw_step, raw=raw,
+                    )
+                    self._recommit_state()
+                    print(f"auto-resumed elastically from a {w_ckpt}-worker "
+                          f"checkpoint at step {resumed} "
+                          f"(now {config.world_size} workers)")
+                else:
+                    resumed = self.restore()
+                    print(f"auto-resumed from checkpoint at step {resumed}")
                 self._auto_resumed = True
-                print(f"auto-resumed from checkpoint at step {resumed}")
 
     # ------------------------------------------------------------------ fit
     def fit(self, num_epochs: Optional[int] = None) -> Dict[str, float]:
