@@ -290,11 +290,17 @@ def _run_bench() -> dict:
     # compilation cache enabled (main()) this compile is a disk hit.
     flops_per_dispatch = _step_flops(trainer)
     uniform_ips = bench_fused(_build(sc, use_is=False, scan_steps=sc["scan"]), sc)
-    pipelined_ips = per_step_ips = unfused_ips = None
+    pipelined_ips = per_step_ips = unfused_ips = cadence_ips = None
     if sc["all_arms"]:
         pipelined_ips = arm("pipelined", lambda: bench_fused(
             _build(sc, use_is=True, scan_steps=sc["scan"],
                    pipelined_scoring=True), sc))
+        # Score-refresh cadence K=8: the measured cost lever (the full
+        # ladder is benchmarks/is_cost_ladder.py). Diagnostic only — the
+        # headline keeps the reference's every-step-scoring semantics.
+        cadence_ips = arm("cadence_k8", lambda: bench_fused(
+            _build(sc, use_is=True, scan_steps=sc["scan"],
+                   score_refresh_every=8), sc))
         per_step_trainer = _build(sc, use_is=True)
         per_step_ips = arm("per_step",
                            lambda: bench_fused(per_step_trainer, sc))
@@ -317,6 +323,7 @@ def _run_bench() -> dict:
         f"# diagnostics [{platform}/{dev.device_kind}]: "
         f"fused_is_scan{sc['scan']}={fused_ips:.1f} "
         f"pipelined_is_scan{sc['scan']}={fmt(pipelined_ips)} "
+        f"cadence_k8_scan{sc['scan']}={fmt(cadence_ips)} "
         f"uniform_sgd_scan{sc['scan']}={uniform_ips:.1f} "
         f"fused_is_per_step_dispatch={fmt(per_step_ips)} "
         f"unfused_reference_loop={fmt(unfused_ips)} img/s"
@@ -334,6 +341,11 @@ def _run_bench() -> dict:
         "device_kind": dev.device_kind,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if cadence_ips:
+        # The cost lever's recovery, alongside the reference-semantics
+        # headline: cadence K=8 throughput and its ratio to uniform.
+        record["cadence_k8"] = round(cadence_ips, 2)
+        record["cadence_k8_vs_baseline"] = round(cadence_ips / uniform_ips, 3)
     if platform != "tpu":
         record["degraded"] = True  # scaled-down CPU protocol, not the chip
     return record

@@ -45,31 +45,37 @@ from mercury_tpu.train.state import MercuryState
 
 
 def probe_checkpoint(
-    directory: str, step: Optional[int] = None
+    directory: str, step: Optional[int] = None, strict: bool = False,
 ) -> Tuple[Optional[dict], Optional[int]]:
     """Read the (newest, or ``step``'s) checkpoint's raw state dict once.
-    Returns ``(raw, step)`` or ``(None, None)`` when absent/unreadable.
-    The raw tree can be handed to :func:`elastic_restore` so a resume
-    that probed the world size first does not deserialize the file
-    twice."""
+    Returns ``(raw, step)``; with ``strict=False`` an absent or unreadable
+    checkpoint yields ``(None, None)`` (the auto-resume probe must not
+    crash construction), with ``strict=True`` read/deserialization errors
+    propagate so a corrupt file surfaces as its real exception, not a
+    misleading not-found. The raw tree can be handed to
+    :func:`elastic_restore` so a resume that probed the world size first
+    does not deserialize the file twice."""
     import flax.serialization
 
     if step is None:
         step = ckpt.latest_step(directory)
         if step is None:
+            if strict:
+                raise FileNotFoundError(f"no checkpoints under {directory}")
             return None, None
     path = ckpt._ckpt_path(directory, step)
     try:
         if os.path.isdir(path):
             ocp = ckpt._orbax()
-            if ocp is None:
-                return None, None
+            assert ocp is not None, "directory checkpoint needs orbax"
             raw = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
             raw = _lists_to_dicts(raw)
         else:
             with open(path + ".msgpack", "rb") as f:
                 raw = flax.serialization.msgpack_restore(f.read())
     except Exception:
+        if strict:
+            raise
         return None, None
     return raw, step
 
@@ -85,11 +91,7 @@ def _read_raw_state(directory: str, template: MercuryState,
     import flax.serialization
 
     if raw is None:
-        raw, step = probe_checkpoint(directory, step)
-        if raw is None:
-            raise FileNotFoundError(
-                f"no readable checkpoint under {directory}"
-            )
+        raw, step = probe_checkpoint(directory, step, strict=True)
     # from_state_dict maps the raw dict back onto the template STRUCTURE
     # without reshaping values — exactly what elastic needs: old-shape
     # leaves inside a navigable MercuryState.

@@ -392,25 +392,35 @@ class Trainer:
                 # bit-exact restore and the elastic one — checked BEFORE
                 # deserializing into a mismatched template, because the
                 # msgpack path would silently accept wrong-shaped sampler
-                # leaves. The probe's raw tree is handed to the elastic
-                # restore so the file is read once, not twice.
-                from mercury_tpu.train.elastic import (
-                    elastic_restore,
-                    probe_checkpoint,
-                    world_size_of_raw,
-                )
-
-                raw, raw_step = probe_checkpoint(config.checkpoint_dir)
-                w_ckpt = world_size_of_raw(raw)
-                if w_ckpt is not None and w_ckpt != config.world_size:
-                    resumed = elastic_restore(
-                        config.checkpoint_dir, self, step=raw_step, raw=raw,
+                # leaves. Single-controller only: the probe is plain local
+                # IO with no cross-process agreement, and divergent
+                # branches would hang mismatched collectives — multi-host
+                # auto_resume keeps the agreed restore path (which
+                # broadcasts its candidate list); a multi-host topology
+                # change uses an explicit restore_elastic call instead.
+                w_ckpt = None
+                raw = raw_step = None
+                if jax.process_count() == 1:
+                    from mercury_tpu.train.elastic import (
+                        probe_checkpoint,
+                        world_size_of_raw,
                     )
-                    self._recommit_state()
+
+                    raw, raw_step = probe_checkpoint(config.checkpoint_dir)
+                    w_ckpt = world_size_of_raw(raw)
+                if w_ckpt is not None and w_ckpt != config.world_size:
+                    # The probe's raw tree feeds the restore — the file is
+                    # deserialized once on this (elastic) branch.
+                    resumed = self.restore_elastic(step=raw_step, raw=raw)
                     print(f"auto-resumed elastically from a {w_ckpt}-worker "
                           f"checkpoint at step {resumed} "
                           f"(now {config.world_size} workers)")
                 else:
+                    # Same topology (the common case): the probe's tree is
+                    # not a substitute for restore()'s corrupt-fallback
+                    # walk, so release it before the second read rather
+                    # than holding two copies of a possibly-large state.
+                    del raw
                     resumed = self.restore()
                     print(f"auto-resumed from checkpoint at step {resumed}")
                 self._auto_resumed = True
@@ -678,17 +688,19 @@ class Trainer:
             )
 
     def restore_elastic(self, directory: Optional[str] = None,
-                        step: Optional[int] = None) -> int:
+                        step: Optional[int] = None, raw=None) -> int:
         """Restore a checkpoint saved at a DIFFERENT world size: model and
         optimizer state transfer exactly (ZeRO-1 chunks reshard W→W′);
         per-worker sampler state re-derives for the new topology. See
-        ``mercury_tpu.train.elastic``. The reference hangs on any topology
-        change (``pytorch_collab.py:291-292``)."""
+        ``mercury_tpu.train.elastic``. ``raw`` passes a pre-probed raw
+        checkpoint tree (with its ``step``) to skip re-reading the file.
+        The reference hangs on any topology change
+        (``pytorch_collab.py:291-292``)."""
         from mercury_tpu.train.elastic import elastic_restore
 
         directory = directory or self.config.checkpoint_dir
         assert directory, "no checkpoint directory configured"
-        step = elastic_restore(directory, self, step)
+        step = elastic_restore(directory, self, step, raw=raw)
         self._recommit_state()
         return step
 
