@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# One-shot on-chip capture queue: run everything that needs the real TPU,
+# tolerating individual failures (the tunnel drops without warning — each
+# artifact lands as soon as its step finishes). Run from the repo root:
+#
+#   bash benchmarks/capture_on_chip.sh
+#
+set -u
+cd "$(dirname "$0")/.."
+
+run() {
+  echo "== $*" >&2
+  timeout "${STEP_TIMEOUT:-2400}" "$@" || echo "== FAILED (rc=$?): $*" >&2
+}
+
+# 1. Headline bench (refreshes bench_last_good.json, now with cadence-K8
+#    diagnostic fields).
+run python bench.py
+
+# 2. MFU vs batch sweep (where the pinned batch-32 shape sits on the
+#    utilization curve).
+run python benchmarks/mfu_sweep.py
+
+# 3. Segment-timing validation against a jax.profiler trace.
+run python benchmarks/profile_validation.py
+
+# 4. PP bubble on the chip (the CPU record says: re-measure here before
+#    ruling a 1F1B schedule in or out).
+run python benchmarks/pp_bubble.py
+
+# 5. BASELINE rows 1-3 on the real bundled digits data (time-to-target
+#    with honest provenance; CIFAR bytes are absent from this image).
+for p in 1 2 3; do
+  run python benchmarks/run.py --preset "$p" --dataset digits \
+      --steps 1500 --eval-every 100 --target-acc 0.80
+done
+
+echo "== capture complete" >&2

@@ -49,11 +49,21 @@ def main(argv=None) -> int:
                     help="max steps before giving up on the target")
     ap.add_argument("--eval-every", type=int, default=100)
     ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--dataset", default=None,
+                    help="override the preset's dataset — e.g. 'digits' "
+                         "(real bundled handwritten-digit scans) when "
+                         "CIFAR binaries are absent; the record carries "
+                         "the actual dataset either way")
     ap.add_argument("--out", type=str,
                     default=os.path.join(os.path.dirname(__file__), "results.jsonl"))
     args = ap.parse_args(argv)
 
     overrides = dict(PRESETS[args.preset])
+    if args.dataset:
+        overrides["dataset"] = args.dataset
+        if args.dataset == "digits":
+            # Flips/crops destroy digit identity (6 vs 9).
+            overrides["augmentation"] = "none"
     scan_steps = 25 if args.eval_every % 25 == 0 else 1
     overrides.update(
         batch_size=args.batch_size,
