@@ -65,6 +65,27 @@ class MercuryState:
     cached_pool: Any = None         # [W]-stacked CachedPool (score_refresh_every>1)
 
 
+def init_worker_sampler_state(
+    stream_key: jax.Array, worker_key: jax.Array,
+    n_workers: int, shard_len: int,
+):
+    """Per-worker sampler state, ``[W]``-stacked: bootstrap EMA, shuffled
+    shard streams, independent PRNG keys. One definition shared by the
+    fused dp step's :func:`create_state` and the dp×sp Mercury step's
+    init (``train/sp_step.py``) so seeding/bootstrap semantics cannot
+    drift between them. Returns ``(ema, stream, rng)``."""
+    from mercury_tpu.data.pipeline import init_shard_streams
+
+    ema0 = init_ema()
+    ema = EMAState(
+        value=jnp.zeros((n_workers,), jnp.float32) + ema0.value,
+        count=jnp.zeros((n_workers,), jnp.int32) + ema0.count,
+    )
+    stream = init_shard_streams(stream_key, n_workers, shard_len)
+    rng = jax.random.split(worker_key, n_workers)
+    return ema, stream, rng
+
+
 def create_state(
     rng: jax.Array,
     model,
@@ -113,13 +134,9 @@ def create_state(
         # (e.g. tensor-parallel layout) — don't allocate a replicated
         # moment tree just to discard it.
         opt_state = None
-    ema0 = init_ema()
-    ema = EMAState(
-        value=jnp.zeros((n_workers,), jnp.float32) + ema0.value,
-        count=jnp.zeros((n_workers,), jnp.int32) + ema0.count,
+    ema, stream, worker_keys = init_worker_sampler_state(
+        stream_key, worker_key, n_workers, shard_len
     )
-    stream = init_shard_streams(stream_key, n_workers, shard_len)
-    worker_keys = jax.random.split(worker_key, n_workers)
     groupwise = None
     if with_groupwise:
         g0 = init_groupwise(shard_len)
