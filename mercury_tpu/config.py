@@ -152,7 +152,9 @@ class TrainConfig:
     #   phases (all-to-all reduce-scatter + all-gather) move int8 payloads
     #   with per-chunk scales and stochastic rounding (unbiased), 4× fewer
     #   bytes than the f32 psum (parallel/collectives.py
-    #   `compressed_allreduce_mean`). Not composable with zero_sharding.
+    #   `compressed_allreduce_mean`). Composes with zero_sharding: the
+    #   ZeRO gradient reduce-scatter and update all-gather both run int8
+    #   on the wire.
     grad_compression: str = "none"
 
     # Bookkeeping -----------------------------------------------------------

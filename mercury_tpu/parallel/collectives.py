@@ -139,21 +139,39 @@ def compressed_allreduce_mean(
     n = vec.shape[0]
     chunk = -(-n // axis_size)
     rows = jnp.pad(vec, (0, chunk * axis_size - n)).reshape(axis_size, chunk)
+    mine = compressed_psum_scatter_mean(rows, axis_name, k1)
+    return compressed_all_gather(mine, axis_name, k2)[:n]
 
-    q, scale = _quantize_rows(k1, rows)                     # [W, C] i8, [W, 1]
-    # Reduce-scatter phase: worker w ends up with all W versions of row w.
+
+def compressed_psum_scatter_mean(
+    rows: jax.Array, axis_name: str, key: jax.Array
+) -> jax.Array:
+    """Reduce-scatter-MEAN with int8 wire payloads: ``rows`` is each
+    worker's ``[W, C]`` chunked vector; returns this worker's chunk's
+    cross-worker mean ``[C]`` f32. Each row is int8-quantized with a
+    per-row scale and stochastic rounding (unbiased), the ``all_to_all``
+    moves int8, and the mean accumulates in f32 (no error compounding
+    across workers). The compressed half of ZeRO-1's gradient
+    reduce-scatter (``lax.psum_scatter ÷ W`` semantics)."""
+    q, scale = _quantize_rows(key, rows)                    # [W, C] i8, [W, 1]
     q_all = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
                            tiled=True)                      # [W, C] i8
     s_all = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
                            tiled=True)                      # [W, 1]
-    mine = jnp.mean(q_all.astype(jnp.float32) * s_all, axis=0)  # [C] f32
+    return jnp.mean(q_all.astype(jnp.float32) * s_all, axis=0)  # [C] f32
 
-    # All-gather phase: re-quantize the reduced chunk, share int8 + scale.
-    my_q, my_scale = _quantize_rows(k2, mine[None])         # [1, C] i8, [1, 1]
+
+def compressed_all_gather(
+    chunk: jax.Array, axis_name: str, key: jax.Array
+) -> jax.Array:
+    """All-gather with int8 wire payloads: each worker contributes its
+    ``[C]`` f32 chunk (int8 + per-chunk scale on the wire, stochastic
+    rounding — unbiased); returns the concatenated ``[W·C]`` f32 vector.
+    The compressed half of ZeRO-1's update all-gather."""
+    my_q, my_scale = _quantize_rows(key, chunk[None])       # [1, C] i8, [1, 1]
     gq = lax.all_gather(my_q[0], axis_name)                 # [W, C] i8
     gs = lax.all_gather(my_scale[0, 0], axis_name)          # [W]
-    out = gq.astype(jnp.float32) * gs[:, None]              # [W, C] f32
-    return out.reshape(-1)[:n]
+    return (gq.astype(jnp.float32) * gs[:, None]).reshape(-1)
 
 
 def compressed_allreduce_mean_tree(

@@ -162,11 +162,6 @@ def make_train_step(
         raise ValueError(f"unknown grad_compression {config.grad_compression!r}")
     compress_grads = config.grad_compression == "stochastic"
     int8_allreduce = config.grad_compression == "int8"
-    if int8_allreduce and config.zero_sharding:
-        raise ValueError(
-            "grad_compression='int8' replaces the allreduce; it does not "
-            "compose with zero_sharding's reduce-scatter path"
-        )
     use_groupwise = use_is and config.sampler == "groupwise"
     pipelined = use_is and config.pipelined_scoring
     zero = config.zero_sharding
@@ -517,6 +512,10 @@ def make_train_step(
             # all-gather IS the ring allreduce, util.py:280-324, so the
             # collective volume matches average_gradients :236-249), update
             # only that chunk's optimizer state, all-gather the updates.
+            # With grad_compression="int8", BOTH wire phases move int8
+            # payloads (per-chunk scales, stochastic rounding — unbiased):
+            # the gradient reduce-scatter and the update all-gather, 4×
+            # fewer bytes each (parallel/collectives.py).
             from mercury_tpu.utils.tree import (
                 pad_to_chunks,
                 tree_flatten_to_vector,
@@ -525,11 +524,30 @@ def make_train_step(
             w = lax.axis_size(axis)
             opt_chunk = jax.tree_util.tree_map(lambda x: x[0], state.opt_state)
             gvec, unravel = tree_flatten_to_vector(grads)
-            gchunk = lax.psum_scatter(pad_to_chunks(gvec, w), axis) / w
+            if int8_allreduce:
+                from mercury_tpu.parallel.collectives import (
+                    compressed_all_gather,
+                    compressed_psum_scatter_mean,
+                )
+
+                kz = jax.random.fold_in(rng, 0x72)
+                kz1, kz2 = jax.random.split(kz)
+                gchunk = compressed_psum_scatter_mean(
+                    pad_to_chunks(gvec, w), axis, kz1
+                )
+            else:
+                gchunk = lax.psum_scatter(pad_to_chunks(gvec, w), axis) / w
             pvec, _ = tree_flatten_to_vector(state.params)
             pchunk = pad_to_chunks(pvec, w)[lax.axis_index(axis)]
             updates_chunk, new_opt_chunk = tx.update(gchunk, opt_chunk, pchunk)
-            uvec = lax.all_gather(updates_chunk, axis, tiled=True)[: gvec.size]
+            if int8_allreduce:
+                uvec = compressed_all_gather(updates_chunk, axis, kz2)[
+                    : gvec.size
+                ]
+            else:
+                uvec = lax.all_gather(
+                    updates_chunk, axis, tiled=True
+                )[: gvec.size]
             new_params = optax.apply_updates(state.params, unravel(uvec))
             new_opt_state = jax.tree_util.tree_map(
                 lambda x: x[None], new_opt_chunk
