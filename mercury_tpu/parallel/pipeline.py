@@ -27,11 +27,14 @@ tick inputs — 1F1B-class memory at GPipe simplicity. The bubble fraction
 cheap.
 
 Composition: sequence parallelism (``sp_axis`` — a 2-D ``pipe × seq``
-mesh, each stage running ring/Ulysses attention over its sequence shard)
-and dense-path MoE blocks (router aux losses accumulated through the
-staged scan and psummed out) both compose; expert-parallel MoE
-(``moe_ep_axis``) does not (the all-to-all would need an expert axis in
-the same shard_map).
+mesh, each stage running ring/Ulysses attention over its sequence shard),
+dense-path MoE blocks (router aux losses accumulated through the staged
+scan and psummed out), and expert-parallel MoE (``moe_ep_axis`` — a 2-D
+``pipe × expert`` mesh: the batch splits over the expert axis per EP's
+token contract, expert weights shard ``P(pipe, expert)`` on their
+stacked ``[L, E, ...]`` leaves, and each stage's MoE dispatch rides its
+``lax.all_to_all`` over the expert axis inside the staged scan) all
+compose.
 
 Embedding/positional/head params stay replicated: their compute is cheap
 and position-local, so only the block stack is staged. Correct gradient
@@ -99,6 +102,14 @@ def make_pp_apply(
     (``P(None, sp_axis)``). Output logits are replicated. Differentiable
     end to end.
 
+    With ``model.moe_ep_axis`` set (pipe×EP), the contract shifts per
+    EP's token semantics: ``mesh`` must carry the expert axis, ``x``'s
+    BATCH dimension arrives sharded over it (``P(ep)``), the stacked
+    blocks' MoE expert leaves (``[L, E, ...]``) must be placed
+    ``P(axis, ep)`` — use ``shard_stacked_blocks(..., model=model,
+    ep=...)`` — and the logits come back batch-sharded ``P(ep)``; the
+    aux stays replicated.
+
     ``remat=True`` re-materializes each tick's stage compute in the
     backward (``jax.checkpoint``) — activation stash drops from all
     ``M`` microbatches to the scan carries, the 1F1B-class memory
@@ -110,12 +121,19 @@ def make_pp_apply(
             f"model.sp_axis={sp!r} needs that axis in the mesh; "
             f"mesh axes: {mesh.axis_names}"
         )
+    ep = None
     if model.moe_experts is not None:
         if model.moe_ep_axis is not None:
-            raise ValueError(
-                "pipeline parallelism composes with dense-path MoE only "
-                "(moe_ep_axis's all-to-all would need an expert mesh axis)"
-            )
+            # Expert parallelism inside the pipeline: a 2-D pipe×expert
+            # mesh — the batch splits over the expert axis (EP's token
+            # contract) and each stage's MoE dispatch rides its
+            # lax.all_to_all over that axis inside the staged scan.
+            if model.moe_ep_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"model.moe_ep_axis={model.moe_ep_axis!r} needs that "
+                    f"axis in the mesh; mesh axes: {mesh.axis_names}"
+                )
+            ep = model.moe_ep_axis
         if not with_aux:
             raise ValueError(
                 "MoE blocks sow a router aux loss: call with with_aux=True "
@@ -156,7 +174,13 @@ def make_pp_apply(
 
         # pcast: the carries become device-varying after one tick, so their
         # initial values must be typed as varying over the pipe axis too.
-        varying_axes = (axis,) if sp is None else (axis, sp)
+        # With expert parallelism the batch is split over the ep axis, so
+        # activations vary over it as well.
+        varying_axes = (axis,)
+        if sp is not None:
+            varying_axes = varying_axes + (sp,)
+        if ep is not None:
+            varying_axes = varying_axes + (ep,)
 
         def apply_stage(h):
             def body(carry, p):
@@ -227,18 +251,65 @@ def make_pp_apply(
         aux_total = lax.psum(aux, axis) / m
         if sp is not None:
             aux_total = lax.pmean(aux_total, sp)
+        if ep is not None:
+            # Each expert rank's aux covers its token slice — average for
+            # the global statistic (replicated output).
+            aux_total = lax.pmean(aux_total, ep)
         return logits, aux_total
 
-    x_spec = P() if sp is None else P(None, sp)
+    if sp is None:
+        x_spec = P() if ep is None else P(ep)
+    else:
+        x_spec = P(None, sp) if ep is None else P(ep, sp)
+    # EP splits the batch: logits come back sharded over the ep axis.
+    logits_spec = P() if ep is None else P(ep)
+    blocks_spec = P(axis) if ep is None else _stacked_block_specs(model, axis, ep)
     sharded = shard_map(
         local_apply,
         mesh=mesh,
-        in_specs=(P(axis), P(), x_spec),
-        out_specs=P() if not with_aux else (P(), P()),
+        in_specs=(blocks_spec, P(), x_spec),
+        out_specs=logits_spec if not with_aux else (logits_spec, P()),
     )
     return jax.jit(sharded)
 
 
-def shard_stacked_blocks(stacked, mesh: Mesh, axis: str = "pipe"):
-    """Place a stacked block tree with its layer axis over the pipe axis."""
-    return jax.device_put(stacked, NamedSharding(mesh, P(axis)))
+_EP_LEAVES = ("w_up", "b_up", "w_down", "b_down")
+
+
+def _stacked_block_specs(model, axis: str, ep: str):
+    """Per-leaf PartitionSpecs for the stacked block tree under pipe×EP:
+    expert-stacked MoE weights (``MoEMLP``'s ``[L, E, ...]`` leaves,
+    identified by leaf name WITHIN the moe submodule — the path scope
+    keeps an unrelated future ``w_up`` elsewhere from silently picking up
+    the expert spec) shard layer-over-pipe AND expert-over-ep; everything
+    else shards the layer axis only. The structure comes from an abstract
+    init of one block with EP disabled (init runs the forward, which must
+    not touch an unbound mesh axis)."""
+    probe = model.make_block(sp_axis=None).clone(moe_ep_axis=None)
+    shapes = jax.eval_shape(
+        lambda k: probe.init(k, jnp.zeros((1, 4, model.d_model)))["params"],
+        jax.random.key(0),
+    )
+
+    def spec_for(path, _):
+        keys = [str(p.key if hasattr(p, "key") else p) for p in path]
+        in_moe = any("moe" in k.lower() for k in keys[:-1])
+        return P(axis, ep) if (in_moe and keys[-1] in _EP_LEAVES) else P(axis)
+
+    return jax.tree_util.tree_map_with_path(spec_for, shapes)
+
+
+def shard_stacked_blocks(stacked, mesh: Mesh, axis: str = "pipe",
+                         model=None, ep: str = None, specs=None):
+    """Place a stacked block tree with its layer axis over the pipe axis.
+    With ``model`` and ``ep`` given (pipe×EP), the MoE expert leaves
+    additionally shard their expert axis over ``ep``; pass ``specs`` (a
+    tree from :func:`_stacked_block_specs`) to skip re-deriving them."""
+    if ep is None and specs is None:
+        return jax.device_put(stacked, NamedSharding(mesh, P(axis)))
+    if specs is None:
+        specs = _stacked_block_specs(model, axis, ep)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs
+    )
+    return jax.device_put(stacked, shardings)

@@ -166,6 +166,11 @@ def make_train_step(
     pipelined = use_is and config.pipelined_scoring
     zero = config.zero_sharding
     if pipelined and use_groupwise:
+        # Measured justification for this cut (round-3 ladder,
+        # BASELINE.md): pipelined overlap recovered ~2% on chip even for
+        # the pool sampler — the scoring cost is FLOPs, not exposed
+        # latency — so a groupwise pipeline's ceiling is the same ~2%.
+        # Cadence (score_refresh_every) is the lever that actually pays.
         raise ValueError("pipelined_scoring requires sampler='pool'")
     cadence = int(config.score_refresh_every)
     if cadence < 1:

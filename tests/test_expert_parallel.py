@@ -210,14 +210,81 @@ class TestMoETransformer:
                                 moe_experts=4, world_size=8),
                     mesh=host_cpu_mesh(8))
 
-    def test_pipeline_rejects_moe(self):
+    def test_pipeline_composes_with_ep_moe(self):
+        """pp×EP (a round-2 rejection hole, now closed): a pipe×expert
+        mesh stages the layers AND shards the experts — logits and router
+        aux match the dense-path MoE through the same pipeline at ample
+        capacity, in values and gradients."""
+        from mercury_tpu.models import TransformerClassifier
+        from mercury_tpu.parallel.pipeline import (
+            make_pp_apply,
+            shard_stacked_blocks,
+            stack_block_params,
+        )
+
+        kw = {**self.kw, "moe_capacity_factor": 8.0}
+        dense_model = TransformerClassifier(**kw)
+        ep_model = TransformerClassifier(moe_ep_axis="expert", **kw)
+        x = jax.random.normal(jax.random.key(3), (8, 8, 6), jnp.float32)
+        params = dense_model.init(jax.random.key(5), x, train=False)["params"]
+        stacked, rest = stack_block_params(params, self.kw["num_layers"])
+
+        pipe_mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+        ref_fwd = make_pp_apply(dense_model, pipe_mesh, 2, with_aux=True)
+        st_ref = shard_stacked_blocks(stacked, pipe_mesh, "pipe")
+        ref_logits, _ = ref_fwd(st_ref, rest, x)
+        # The router aux is a per-microbatch statistic, so it depends on
+        # how the batch GROUPS into microbatches. EP rank e's microbatch t
+        # holds samples e*(B/E) + [t*mb, (t+1)*mb); its aux psums over ep,
+        # so the effective group is the UNION over ranks. Feed the dense
+        # path the batch permuted into exactly those groups for an
+        # apples-to-apples aux/grad reference (sum(lg^2) is
+        # permutation-invariant, so the logits loss term is unaffected).
+        group_perm = np.array([0, 1, 4, 5, 2, 3, 6, 7])
+        ref_logits_g, ref_aux = ref_fwd(st_ref, rest, x[group_perm])
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("pipe", "expert"))
+        ep_fwd = make_pp_apply(ep_model, mesh, 2, with_aux=True)
+        st_ep = shard_stacked_blocks(stacked, mesh, "pipe",
+                                     model=ep_model, ep="expert")
+        ep_logits, ep_aux = ep_fwd(st_ep, rest, x)
+        np.testing.assert_allclose(np.asarray(ep_logits),
+                                   np.asarray(ref_logits),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(float(ep_aux), float(ref_aux), rtol=1e-5)
+
+        # Expert leaves physically shard over BOTH axes. (The stacked
+        # tree has one block's structure with a leading layer axis.)
+        moe_key = next(k for k in st_ep if "moe" in k.lower())
+        wup = st_ep[moe_key]["w_up"]
+        assert wup.addressable_shards[0].data.shape[0] == wup.shape[0] // 2
+        assert wup.addressable_shards[0].data.shape[1] == wup.shape[1] // 2
+
+        # Gradients: d(sum logits + aux)/d stacked match the dense path.
+        def loss_ref(st):
+            lg, ax = ref_fwd(st, rest, x[group_perm])
+            return jnp.sum(lg * lg) + ax
+
+        def loss_ep(st):
+            lg, ax = ep_fwd(st, rest, x)
+            return jnp.sum(lg * lg) + ax
+
+        g_ref = jax.grad(loss_ref)(st_ref)
+        g_ep = jax.grad(loss_ep)(st_ep)
+        for a, b in zip(jax.tree_util.tree_leaves(g_ep),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_pipeline_ep_requires_mesh_axis(self):
         from mercury_tpu.models import TransformerClassifier
         from mercury_tpu.parallel.pipeline import make_pp_apply
 
-        model = TransformerClassifier(**{**self.kw, "num_layers": 4})
-        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
-        with pytest.raises(ValueError, match="MoE"):
-            make_pp_apply(model, mesh, 4)
+        model = TransformerClassifier(moe_ep_axis="expert", **self.kw)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+        with pytest.raises(ValueError, match="expert"):
+            make_pp_apply(model, mesh, 2, with_aux=True)
 
 
 class TestTraining:

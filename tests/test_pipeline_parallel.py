@@ -208,13 +208,16 @@ class TestRematAndCompositions:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-4, atol=1e-5)
 
-    def test_ep_moe_rejected(self):
+    def test_ep_moe_needs_expert_axis(self):
+        """pp×EP composes on a pipe×expert mesh
+        (test_expert_parallel.py::test_pipeline_composes_with_ep_moe);
+        a pipe-only mesh still rejects with the missing-axis message."""
         moe_ep = TransformerClassifier(num_classes=C, d_model=D, num_heads=2,
                                        num_layers=L, max_len=T, moe_experts=2,
                                        moe_ep_axis="expert",
                                        moe_capacity_factor=8.0)
         mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
-        with pytest.raises(ValueError, match="dense-path MoE"):
+        with pytest.raises(ValueError, match="expert"):
             make_pp_apply(moe_ep, mesh, num_microbatches=2, with_aux=True)
 
     def test_moe_requires_with_aux(self):
