@@ -29,6 +29,18 @@ COMBOS = {
     "scan+zero": dict(scan_steps=2, zero_sharding=True),
     "scan+int8+pipelined": dict(scan_steps=2, grad_compression="int8",
                                 pipelined_scoring=True),
+    # Round 3: score-refresh cadence through the rest of the matrix — the
+    # CachedPool state field must thread through every path variant.
+    "cadence+zero": dict(score_refresh_every=2, zero_sharding=True),
+    "cadence+int8": dict(score_refresh_every=2, grad_compression="int8"),
+    "cadence+accum": dict(score_refresh_every=2, grad_accum_steps=2),
+    "cadence+sharded-data": dict(score_refresh_every=2,
+                                 data_placement="sharded"),
+    "cadence+scan+zero": dict(score_refresh_every=2, scan_steps=2,
+                              zero_sharding=True),
+    # Round 3: int8 x ZeRO (both wire phases compressed) under scan.
+    "int8+zero+scan": dict(grad_compression="int8", zero_sharding=True,
+                           scan_steps=2),
 }
 
 
@@ -44,8 +56,10 @@ def test_combo_trains_finite(name):
     step_fn = tr.train_step_many or tr.train_step
     steps = 6 // max(cfg.scan_steps, 1)
     for _ in range(steps):
-        tr.state, m = step_fn(tr.state, tr.dataset.x_train,
-                              tr.dataset.y_train, tr.dataset.shard_indices)
+        # _step_x/_step_y: correct for both data placements (they alias
+        # the dataset arrays under "replicated").
+        tr.state, m = step_fn(tr.state, tr._step_x, tr._step_y,
+                              tr.dataset.shard_indices)
         loss = np.asarray(m["train/loss"])
         assert np.all(np.isfinite(loss)), (name, loss)
     assert int(tr.state.step) == 6
