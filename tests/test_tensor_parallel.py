@@ -268,3 +268,31 @@ class TestMercuryISWithTP:
                 c.dataset.shard_indices)
             losses_c.append(float(m["train/loss"]))
         np.testing.assert_allclose(losses_c, losses_a[1:], rtol=1e-4)
+
+
+class TestTPCadence:
+    def test_tp_composes_with_score_cadence(self):
+        """score_refresh_every through the dp×tp step: the CachedPool
+        state field must appear in the TP out-shardings pin (sharded over
+        data, untouched by GSPMD's model-axis partitioning)."""
+        from mercury_tpu.config import TrainConfig
+        from mercury_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(
+            model="transformer", dataset="synthetic_seq",
+            augmentation="none", world_size=2, tensor_parallel=2,
+            batch_size=4, presample_batches=2, steps_per_epoch=4,
+            num_epochs=1, eval_every=0, log_every=0,
+            compute_dtype="float32", seed=0, score_refresh_every=2,
+        )
+        tr = Trainer(cfg)
+        for _ in range(4):
+            tr.state, m = tr.train_step(
+                tr.state, tr.dataset.x_train, tr.dataset.y_train,
+                tr.dataset.shard_indices)
+            assert np.isfinite(float(m["train/loss"]))
+        assert int(tr.state.step) == 4
+        # Refreshes at steps 0 and 2 only.
+        assert int(np.asarray(tr.state.ema.count).max()) == 2
+        probs = np.asarray(tr.state.cached_pool.probs)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
