@@ -43,6 +43,20 @@ class TrainConfig:
     # world_size × tensor_parallel.
     tensor_parallel: int = 1
     model_axis: str = "model"        # name of the tensor-parallel mesh axis
+    # FSDP (ZeRO-3 analogue) WITHIN each data-parallel worker: a second
+    # mesh axis of this size over which every large parameter leaf is
+    # sharded along its largest divisible dimension
+    # (parallel/fsdp.py:fsdp_shardings); optimizer moments inherit the
+    # layout (ZeRO-2 for free). The Mercury IS step runs manual-SPMD over
+    # the data axis and leaves this axis to GSPMD — XLA inserts the
+    # per-layer weight all-gathers and gradient reduce-scatters — so the
+    # scoring forward, draw, reweighted backward, and stat psum all
+    # execute with params fully sharded. Works for ANY model family
+    # (unlike tensor_parallel's Megatron layout). Total devices =
+    # world_size × fsdp_parallel. Mutually exclusive with
+    # tensor_parallel > 1 and zero_sharding.
+    fsdp_parallel: int = 1
+    fsdp_axis: str = "fsdp"          # name of the FSDP mesh axis
     # Train-data placement. "replicated" (default): the full train arrays
     # are device-resident and every worker gathers its shard rows by
     # global index — fine for CIFAR, a dead end past it. "sharded": each

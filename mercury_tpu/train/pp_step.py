@@ -83,6 +83,7 @@ def make_pp_mercury_step(
     axis: str = "pipe",
     is_alpha: float = 0.5,
     ema_alpha: float = 0.9,
+    moe_aux_weight: float = 0.01,
 ) -> Callable[..., Tuple[PPMercuryState, dict]]:
     """Build ``step(state, x_train, y_train) → (state, metrics)``.
 
@@ -91,6 +92,13 @@ def make_pp_mercury_step(
     pool (``presample_batches × batch_size`` candidates) and the drawn
     train batch both flow through the pipelined forward, so both must be
     divisible by ``num_microbatches``.
+
+    MoE models compose: the Switch router's load-balancing aux loss flows
+    out of the staged scan (``make_pp_apply(with_aux=True)``) and enters
+    the training objective as ``moe_aux_weight × aux`` — the same term the
+    fused data-parallel step applies (``train/step.py``,
+    ``config.moe_aux_weight``). The scoring pass discards the aux (scores
+    are per-sample CE, matching ``pytorch_collab.py:102``).
     """
     pool_size = presample_batches * batch_size
     if pool_size % num_microbatches or batch_size % num_microbatches:
@@ -98,19 +106,9 @@ def make_pp_mercury_step(
             f"pool ({pool_size}) and batch ({batch_size}) must divide by "
             f"num_microbatches ({num_microbatches})"
         )
-    if getattr(model, "moe_experts", None) is not None:
-        # make_pp_apply would demand with_aux=True for a router model, but
-        # this step has no plumbing for the load-balancing aux loss — fail
-        # here with the actual constraint instead of relaying advice the
-        # caller cannot follow.
-        raise ValueError(
-            "make_pp_mercury_step does not support MoE models: the Switch "
-            "router's load-balancing aux loss is not plumbed through the "
-            "pipelined Mercury step; use a dense transformer here, or the "
-            "fused data-parallel step (make_train_step) for MoE"
-        )
+    moe = getattr(model, "moe_experts", None) is not None
     pp_fwd = make_pp_apply(model, mesh, num_microbatches, axis,
-                           with_aux=False)
+                           with_aux=moe)
 
     def step(state: PPMercuryState, x_train, y_train):
         k_stream, k_sel, k_next = jax.random.split(state.rng, 3)
@@ -119,7 +117,8 @@ def make_pp_mercury_step(
         pool_y = y_train[slots]
 
         # Score the pool through the pipeline (one schedule pass).
-        pool_logits = pp_fwd(state.stacked, state.rest, pool_x)
+        pool_out = pp_fwd(state.stacked, state.rest, pool_x)
+        pool_logits = pool_out[0] if moe else pool_out
         pool_losses = per_sample_loss(pool_logits, pool_y)
         sel = select_from_pool(
             k_sel, pool_losses, state.ema, batch_size,
@@ -127,13 +126,17 @@ def make_pp_mercury_step(
         )
 
         def loss_fn(stacked, rest):
-            logits = pp_fwd(stacked, rest, pool_x[sel.selected])
-            return reweighted_loss(
+            out = pp_fwd(stacked, rest, pool_x[sel.selected])
+            logits, aux = out if moe else (out, jnp.zeros((), jnp.float32))
+            total = reweighted_loss(
                 per_sample_loss(logits, pool_y[sel.selected]),
                 sel.scaled_probs,
-            ), logits
+            )
+            if moe:
+                total = total + moe_aux_weight * aux
+            return total, (logits, aux)
 
-        (loss, logits), grads = jax.value_and_grad(
+        (loss, (logits, moe_aux)), grads = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True
         )(state.stacked, state.rest)
         updates, opt_state = tx.update(
@@ -155,6 +158,7 @@ def make_pp_mercury_step(
             "train/loss": loss,
             "train/acc": acc,
             "train/pool_loss": sel.avg_pool_loss,
+            "train/moe_aux": moe_aux,
         }
 
     return jax.jit(step, donate_argnums=(0,))

@@ -140,13 +140,19 @@ def make_train_step(
     if tp_active and config.zero_sharding:
         raise ValueError(
             "zero_sharding flattens params to a vector, which would force "
-            "an all-gather of tensor-parallel shards; use FSDP or plain "
-            "allreduce with tensor_parallel > 1"
+            "an all-gather of the sharded params; use fsdp_parallel or "
+            "plain allreduce when a second mesh axis shards the params"
         )
-    if tp_active and config.grad_compression == "int8":
-        raise ValueError(
-            "grad_compression='int8' (ring ppermute on flattened grads) "
-            "does not compose with tensor_parallel > 1"
+    # int8 wire compression composes with TP/FSDP via the per-leaf path:
+    # the flattened collective would force an all-gather of the sharded
+    # leaves, so under an active auto axis each leaf is compressed in its
+    # natural shape, wire-chunked along a dim the auto axes don't claim
+    # (parallel/collectives.py compressed_pmean_tree_sharded — closes the
+    # round-3 int8×TP rejection).
+    sharded_param_specs = None
+    if state_out_shardings is not None:
+        sharded_param_specs = jax.tree_util.tree_map(
+            lambda s: s.spec, state_out_shardings[0].params
         )
 
     use_pallas = config.use_pallas
@@ -561,14 +567,28 @@ def make_train_step(
             # --- gradient allreduce (≡ average_gradients, :236-249) in-graph
             if int8_allreduce:
                 # int8 on the wire, both phases (collectives.py); unbiased.
-                from mercury_tpu.parallel.collectives import (
-                    compressed_allreduce_mean_tree,
-                )
+                if tp_active:
+                    # Per-leaf, shape-preserving compression: the wire
+                    # chunking avoids the dims TP/FSDP shard, so the
+                    # grads stay sharded through both phases.
+                    from mercury_tpu.parallel.collectives import (
+                        compressed_pmean_tree_sharded,
+                    )
 
-                grads = compressed_allreduce_mean_tree(
-                    grads, axis, lax.axis_size(axis),
-                    jax.random.fold_in(rng, 0x72),
-                )
+                    grads = compressed_pmean_tree_sharded(
+                        grads, axis, lax.axis_size(axis),
+                        jax.random.fold_in(rng, 0x72),
+                        specs=sharded_param_specs,
+                    )
+                else:
+                    from mercury_tpu.parallel.collectives import (
+                        compressed_allreduce_mean_tree,
+                    )
+
+                    grads = compressed_allreduce_mean_tree(
+                        grads, axis, lax.axis_size(axis),
+                        jax.random.fold_in(rng, 0x72),
+                    )
             else:
                 grads = allreduce_mean_tree(grads, axis)
             updates, new_opt_state = tx.update(

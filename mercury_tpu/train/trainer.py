@@ -88,6 +88,12 @@ class Trainer:
         self.config = config
         self.dataset = dataset if dataset is not None else build_dataset(config)
         tp = config.tensor_parallel
+        fs = config.fsdp_parallel
+        if tp > 1 and fs > 1:
+            raise ValueError(
+                "tensor_parallel and fsdp_parallel are mutually exclusive "
+                "(both claim the second mesh axis); pick one"
+            )
         if mesh is not None:
             self.mesh = mesh
         elif tp > 1:
@@ -95,6 +101,11 @@ class Trainer:
 
             self.mesh = make_tp_mesh(config.world_size, tp,
                                      config.mesh_axis, config.model_axis)
+        elif fs > 1:
+            from mercury_tpu.parallel.mesh import make_tp_mesh
+
+            self.mesh = make_tp_mesh(config.world_size, fs,
+                                     config.mesh_axis, config.fsdp_axis)
         else:
             self.mesh = make_mesh(config.world_size, config.mesh_axis)
         if self.mesh.shape[config.mesh_axis] != config.world_size:
@@ -115,6 +126,14 @@ class Trainer:
                     f"mesh must carry a {config.model_axis!r} axis of size "
                     f"{tp}; mesh axes: {dict(self.mesh.shape)}"
                 )
+        if fs > 1 and (
+            config.fsdp_axis not in self.mesh.axis_names
+            or self.mesh.shape[config.fsdp_axis] != fs
+        ):
+            raise ValueError(
+                f"mesh must carry a {config.fsdp_axis!r} axis of size "
+                f"{fs}; mesh axes: {dict(self.mesh.shape)}"
+            )
 
         if (
             config.num_classes is not None
@@ -193,7 +212,7 @@ class Trainer:
                                   if config.augmentation == "iid"
                                   else sample_shape),
             zero_sharding=config.zero_sharding,
-            init_opt=(tp == 1),
+            init_opt=(tp == 1 and fs == 1),
             cached_pool_size=(
                 config.candidate_pool_size
                 if config.use_importance_sampling
@@ -202,37 +221,46 @@ class Trainer:
                 else 0
             ),
         )
-        if tp > 1:
-            # Commit params in the Megatron column/row TP layout and
-            # re-derive the optimizer state from the sharded params (its
-            # moments inherit the layout). The train step is manual-SPMD
-            # over the data axis only, so GSPMD reads these committed
-            # shardings and partitions every block matmul over the model
-            # axis (parallel/tensor.py).
-            from mercury_tpu.parallel.tensor import transformer_tp_shardings
-
-            if self.model.num_heads % tp != 0:
-                raise ValueError(
-                    f"num_heads={self.model.num_heads} must be divisible "
-                    f"by tensor_parallel={tp}"
+        params_sharded = tp > 1 or fs > 1
+        if params_sharded:
+            # Commit params in the sharded layout — Megatron column/row
+            # under tensor_parallel, per-leaf largest-dim FSDP under
+            # fsdp_parallel — and re-derive the optimizer state from the
+            # sharded params (its moments inherit the layout). The train
+            # step is manual-SPMD over the data axis only, so GSPMD reads
+            # these committed shardings and partitions every matmul /
+            # inserts the weight all-gathers over the second axis
+            # (parallel/tensor.py, parallel/fsdp.py).
+            if tp > 1:
+                from mercury_tpu.parallel.tensor import (
+                    transformer_tp_shardings,
                 )
-            param_sh = transformer_tp_shardings(self.state.params, self.mesh,
-                                                config.model_axis)
-            if jax.process_count() == 1:
-                tp_params = jax.device_put(self.state.params, param_sh)
-                # create_state skipped tx.init (init_opt=False): the single
-                # init below inherits the TP layout via zeros_like — no
-                # transient replicated moment tree.
-                tp_opt = self.tx.init(tp_params)
-                self.state = self.state.replace(params=tp_params,
-                                                opt_state=tp_opt)
+
+                if self.model.num_heads % tp != 0:
+                    raise ValueError(
+                        f"num_heads={self.model.num_heads} must be divisible "
+                        f"by tensor_parallel={tp}"
+                    )
+                param_sh = transformer_tp_shardings(
+                    self.state.params, self.mesh, config.model_axis
+                )
             else:
-                # Multi-controller: device_put cannot target other hosts'
-                # devices — the TP placement happens inside
-                # globalize_state below (params_sharding=param_sh), and
-                # the optimizer init runs as an SPMD program on the placed
-                # params afterwards.
-                tp_opt = None
+                from mercury_tpu.parallel.fsdp import fsdp_shardings
+
+                param_sh = fsdp_shardings(self.state.params, self.mesh,
+                                          config.fsdp_axis)
+            if jax.process_count() == 1:
+                sh_params = jax.device_put(self.state.params, param_sh)
+                # create_state skipped tx.init (init_opt=False): the single
+                # init below inherits the sharded layout via zeros_like — no
+                # transient replicated moment tree.
+                sh_opt = self.tx.init(sh_params)
+                self.state = self.state.replace(params=sh_params,
+                                                opt_state=sh_opt)
+            # Multi-controller: device_put cannot target other hosts'
+            # devices — the placement happens inside globalize_state below
+            # (params_sharding=param_sh), and the optimizer init runs as an
+            # SPMD program on the placed params afterwards.
             self._tp_param_sh = param_sh
         else:
             self._state_out_shardings = None
@@ -265,9 +293,10 @@ class Trainer:
             self.state = globalize_state(
                 self.state, self.mesh, config.mesh_axis,
                 zero_sharding=config.zero_sharding,
-                params_sharding=(self._tp_param_sh if tp > 1 else None),
+                params_sharding=(self._tp_param_sh if params_sharded
+                                 else None),
             )
-            if tp > 1:
+            if params_sharded:
                 # SPMD optimizer init on the TP-placed params, with the
                 # moment layout pinned explicitly (opt_sharding_like):
                 # zeros_like gives the partitioner no constraint to
@@ -289,7 +318,7 @@ class Trainer:
                 self.dataset, self.mesh, config.mesh_axis,
                 include_train_arrays=not data_sharded,
             )
-        if tp > 1:
+        if params_sharded:
             # The moment layout is DERIVED (opt_sharding_like), not
             # inferred from live leaves: the structural param-path match
             # is exact for optax states, where sharding inference from a
@@ -360,10 +389,11 @@ class Trainer:
         # Shard eval batches over the mesh so evaluation uses every device
         # (single-controller only: multi-process would need global eval
         # arrays; there the replicated path is correct, just redundant).
-        # Under TP the explicit in_shardings would force the TP-sharded
+        # Under TP/FSDP the explicit in_shardings would force the sharded
         # params to replicate; plain jit lets GSPMD partition eval too.
         eval_mesh = (self.mesh
-                     if jax.process_count() == 1 and tp == 1 else None)
+                     if jax.process_count() == 1 and not params_sharded
+                     else None)
         self.eval_epoch = make_eval_epoch(self.model, self.dataset.mean,
                                           self.dataset.std,
                                           eval_augmentation=config.augmentation
@@ -640,7 +670,8 @@ class Trainer:
                 eval_augmentation=self.config.augmentation
                 if self.config.augmentation == "iid" else "none",
                 mesh=(self.mesh if jax.process_count() == 1
-                      and self.config.tensor_parallel == 1 else None),
+                      and self.config.tensor_parallel == 1
+                      and self.config.fsdp_parallel == 1 else None),
                 axis=self.config.mesh_axis,
             )
         images_b, labels_b, valid_b = self._eval_arrays(train)

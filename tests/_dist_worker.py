@@ -195,11 +195,61 @@ def main(port: str, pid: int) -> None:
     )
     assert leaf_after.addressable_shards[0].data.nbytes * 2 == leaf_after.nbytes
 
+    # 9. Elastic W→W′ on the SAME 2-process cluster (round-4: the
+    #    multi-controller arm the round-3 review flagged as missing —
+    #    elastic exists for preemption, which only happens multi-host).
+    #    Train 8-way on the full cluster mesh, checkpoint, rebuild 4-way
+    #    on a cross-process sub-mesh (2 devices from EACH host), restore
+    #    elastically: params/moments transfer bit-exactly, the EMA warm
+    #    start broadcasts, and the resumed 4-way step runs. The reference
+    #    hangs forever on any topology change (pytorch_collab.py:291-292).
+    import collections
+
+    from jax.sharding import Mesh
+
+    eck = os.path.join(ckpt_dir, "elastic")
+    tr_e = Trainer(cfg.replace(checkpoint_dir=eck), mesh=mesh)
+    for _ in range(2):
+        tr_e.state, _ = tr_e.train_step(
+            tr_e.state, tr_e.dataset.x_train, tr_e.dataset.y_train,
+            tr_e.dataset.shard_indices,
+        )
+    tr_e.save()
+    want_p = [np.asarray(l)
+              for l in jax.tree_util.tree_leaves(tr_e.state.params)]
+    want_o = [np.asarray(l)
+              for l in jax.tree_util.tree_leaves(tr_e.state.opt_state)]
+
+    by_proc = collections.defaultdict(list)
+    for d in jax.devices():
+        by_proc[d.process_index].append(d)
+    sub = [d for p in sorted(by_proc)
+           for d in sorted(by_proc[p], key=lambda d: d.id)[:2]]
+    sub_mesh = Mesh(np.array(sub), ("data",))
+    tr_e4 = Trainer(cfg.replace(world_size=4, checkpoint_dir=eck),
+                    mesh=sub_mesh)
+    estep = tr_e4.restore_elastic()
+    assert estep == 2, estep
+    for a, b in zip(want_p,
+                    jax.tree_util.tree_leaves(tr_e4.state.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    for a, b in zip(want_o,
+                    jax.tree_util.tree_leaves(tr_e4.state.opt_state)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    assert tr_e4.state.ema.value.shape == (4,)
+    tr_e4.state, me4 = tr_e4.train_step(
+        tr_e4.state, tr_e4.dataset.x_train, tr_e4.dataset.y_train,
+        tr_e4.dataset.shard_indices,
+    )
+    el = float(me4["train/loss"])
+    assert np.isfinite(el), el
+    assert int(tr_e4.state.step) == 3
+
     # Full precision (hex) so the cross-process comparison is bit-for-bit.
     print(f"OK {psum_val} {pmean_val} {mine.tolist()} "
           f"loss={losses[-1].hex()} post={post.hex()} zero={zloss.hex()} "
           f"sharded={sl.hex()} sharded_frac={local_bytes/full_bytes:.3f} "
-          f"tp={tl.hex()}",
+          f"tp={tl.hex()} elastic={el.hex()}",
           flush=True)
 
 

@@ -324,3 +324,32 @@ class TestPipeline:
         assert plan[-1][1] == 2  # last batch valid count
         covered = sorted(set(int(i) for idx, valid in plan for i in idx[:valid]))
         assert covered == list(range(10))
+
+
+class TestDigitsDatasets:
+    """The bundled real-image stand-in (sklearn digits) and its
+    class-imbalanced variant (round-4 flagship experiment task)."""
+
+    def test_digits_shapes_and_split(self):
+        (xtr, ytr), (xte, yte), info = load_dataset("digits", seed=0)
+        assert xtr.shape[1:] == (32, 32, 3) and xtr.dtype == np.uint8
+        assert len(xtr) + len(xte) == 1797
+        assert info["num_classes"] == 10 and not info["synthetic"]
+        # Deterministic in seed.
+        (xtr2, _), _, _ = load_dataset("digits", seed=0)
+        np.testing.assert_array_equal(xtr, xtr2)
+
+    def test_digits_imb_rare_classes_subsampled(self):
+        (xtr, ytr), (xte, yte), info = load_dataset("digits_imb", seed=0)
+        (_, ytr_full), (_, yte_full), _ = load_dataset("digits", seed=0)
+        counts = np.bincount(ytr, minlength=10)
+        full = np.bincount(ytr_full, minlength=10)
+        # Common classes untouched, rare classes cut to ~10%.
+        np.testing.assert_array_equal(counts[:5], full[:5])
+        for c in range(5, 10):
+            assert counts[c] <= max(int(round(0.1 * full[c])), 8) + 1, (
+                c, counts[c], full[c]
+            )
+            assert counts[c] >= 8
+        # The TEST split stays balanced (identical to the base variant).
+        np.testing.assert_array_equal(yte, yte_full)

@@ -114,3 +114,107 @@ class TestFsdp:
         ln = [s for name, s in flat.items() if "LayerNorm" in name]
         assert ln and all(s.spec == () or s.spec == (None,) * len(s.spec)
                           for s in ln)
+
+
+class TestFsdpMercury:
+    """The flagship importance-sampled step composed with FSDP
+    (``config.fsdp_parallel``): the SAME fused IS program (scoring forward,
+    EMA, draw, reweighted backward, stat psum) runs with every large param
+    leaf sharded 1/F over the fsdp axis — GSPMD inserts the per-layer
+    weight all-gathers and gradient reduce-scatters — numerically equal to
+    the replicated-params IS step. Closes the one matrix hole the round-3
+    review found (FSDP was uniform-only); extends ``average_gradients``
+    parity (pytorch_collab.py:236-249) to the full memory-sharding ladder.
+    """
+
+    def _cfg(self, **kw):
+        from mercury_tpu.config import TrainConfig
+
+        base = dict(model="transformer", dataset="synthetic_seq",
+                    augmentation="none", world_size=2, batch_size=4,
+                    presample_batches=2, steps_per_epoch=3, num_epochs=1,
+                    eval_every=0, log_every=0, compute_dtype="float32",
+                    seed=0, sync_importance_stats=True)
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def test_fsdp_is_step_matches_replicated(self):
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+        from mercury_tpu.train.trainer import Trainer
+
+        base = Trainer(self._cfg(), mesh=host_cpu_mesh(2))
+        fs = Trainer(self._cfg(fsdp_parallel=2))
+        for _ in range(3):
+            base.state, mb = base.train_step(
+                base.state, base.dataset.x_train, base.dataset.y_train,
+                base.dataset.shard_indices)
+            fs.state, mf = fs.train_step(
+                fs.state, fs.dataset.x_train, fs.dataset.y_train,
+                fs.dataset.shard_indices)
+            np.testing.assert_allclose(float(mf["train/loss"]),
+                                       float(mb["train/loss"]), rtol=1e-4)
+        # Absolute tolerance only: sharded reductions reassociate fp32 and
+        # Adam amplifies last-ulp differences (losses pinned above).
+        for a, b in zip(jax.tree_util.tree_leaves(base.state.params),
+                        jax.tree_util.tree_leaves(fs.state.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0, atol=2e-3)
+
+    def test_fsdp_layout_stable_and_moments_sharded(self):
+        """Params AND optimizer moments stay fsdp-sharded after every step
+        (out_shardings pin) — GSPMD must not re-replicate them."""
+        from mercury_tpu.train.trainer import Trainer
+
+        fs = Trainer(self._cfg(fsdp_parallel=2))
+        param_specs = {str(l.sharding.spec)
+                       for l in jax.tree_util.tree_leaves(fs.state.params)}
+        assert any("fsdp" in s for s in param_specs), param_specs
+        before = [l.sharding for l in
+                  jax.tree_util.tree_leaves(fs.state.params)]
+        for _ in range(2):
+            fs.state, _ = fs.train_step(
+                fs.state, fs.dataset.x_train, fs.dataset.y_train,
+                fs.dataset.shard_indices)
+        after = [l.sharding for l in
+                 jax.tree_util.tree_leaves(fs.state.params)]
+        assert before == after
+        opt_specs = {str(l.sharding.spec)
+                     for l in jax.tree_util.tree_leaves(fs.state.opt_state)
+                     if hasattr(l, "sharding")}
+        assert any("fsdp" in s for s in opt_specs), opt_specs
+
+    def test_fsdp_is_e2e_learns(self):
+        from mercury_tpu.train.trainer import Trainer
+
+        fs = Trainer(self._cfg(fsdp_parallel=2, steps_per_epoch=20))
+        losses = []
+        for _ in range(20):
+            fs.state, m = fs.train_step(
+                fs.state, fs.dataset.x_train, fs.dataset.y_train,
+                fs.dataset.shard_indices)
+            losses.append(float(m["train/loss"]))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_fsdp_rejects_tp_and_zero(self):
+        import pytest
+
+        from mercury_tpu.train.trainer import Trainer
+
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            Trainer(self._cfg(fsdp_parallel=2, tensor_parallel=2))
+        with pytest.raises(ValueError, match="zero_sharding"):
+            Trainer(self._cfg(fsdp_parallel=2, zero_sharding=True))
+
+    def test_fsdp_works_for_cnn_family(self):
+        """Unlike tensor_parallel (Megatron layout, transformer-only),
+        fsdp_parallel shards ANY model family — one IS step on the CNN
+        path with conv kernels fsdp-sharded."""
+        from mercury_tpu.train.trainer import Trainer
+
+        cfg = self._cfg(model="smallcnn", dataset="synthetic",
+                        augmentation="noniid", fsdp_parallel=2)
+        tr = Trainer(cfg)
+        tr.state, m = tr.train_step(
+            tr.state, tr.dataset.x_train, tr.dataset.y_train,
+            tr.dataset.shard_indices)
+        assert np.isfinite(float(m["train/loss"]))
