@@ -42,6 +42,32 @@ import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
 import numpy as np  # noqa: E402
 
 
+def _snapshot_setup(trainer, batch_stats):
+    """Shared fixture for both measurement modes: the worker's shard
+    arrays and the scoring forward (train mode, running stats discarded —
+    the step's scorer, train/step.py). One definition so the MC and
+    analytic modes cannot drift."""
+    import jax.numpy as jnp
+
+    ds = trainer.dataset
+    model = trainer.model
+    shard = np.asarray(ds.shard_indices[0])
+    x_shard = jnp.asarray(np.asarray(ds.x_train)[shard])
+    y_shard = jnp.asarray(np.asarray(ds.y_train)[shard])
+
+    def fwd(p, imgs):
+        variables = {"params": p}
+        mutable = []
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            mutable = ["batch_stats"]
+        out = model.apply(variables, imgs, train=True, mutable=mutable)
+        return out[0] if mutable else out
+
+    return (fwd, ds.mean, ds.std, x_shard, y_shard,
+            int(x_shard.shape[0]))
+
+
 def measure_snapshot(trainer, params, batch_stats, key, n_pool, batch_size,
                      trials, is_alpha):
     """Variance/bias of the three estimators at fixed params. Returns a
@@ -58,24 +84,8 @@ def measure_snapshot(trainer, params, batch_stats, key, n_pool, batch_size,
         per_sample_loss,
     )
 
-    ds = trainer.dataset
-    model = trainer.model
-    mean, std = ds.mean, ds.std
-    shard = np.asarray(ds.shard_indices[0])
-    x_shard = jnp.asarray(np.asarray(ds.x_train)[shard])
-    y_shard = jnp.asarray(np.asarray(ds.y_train)[shard])
-    shard_len = int(x_shard.shape[0])
-
-    def fwd(p, imgs):
-        """Scoring/training forward (train mode, running stats
-        discarded — the step's scorer, train/step.py)."""
-        variables = {"params": p}
-        mutable = []
-        if batch_stats:
-            variables["batch_stats"] = batch_stats
-            mutable = ["batch_stats"]
-        out = model.apply(variables, imgs, train=True, mutable=mutable)
-        return out[0] if mutable else out
+    fwd, mean, std, x_shard, y_shard, shard_len = _snapshot_setup(
+        trainer, batch_stats)
 
     def grad_vec(p, imgs, labels, weights):
         def loss_fn(pp):
@@ -85,21 +95,30 @@ def measure_snapshot(trainer, params, batch_stats, key, n_pool, batch_size,
         g = jax.grad(loss_fn)(p)
         return ravel_pytree(g)[0]
 
-    # Full-shard mean gradient (the quantity every estimator estimates).
+    # Full-shard mean gradient (the quantity every estimator estimates) —
+    # padded final batch with zero weights, so ALL shard_len samples
+    # contribute (the pools draw from all of them).
     def shard_grad(p):
+        nb = -(-shard_len // batch_size)
+        pad = nb * batch_size - shard_len
+        xp = jnp.pad(x_shard, [(0, pad)] + [(0, 0)] * (x_shard.ndim - 1))
+        yp = jnp.pad(y_shard, (0, pad))
+        dim = ravel_pytree(p)[0].size
+
         def body(acc, i):
             imgs = normalize_images(
-                jax.lax.dynamic_slice_in_dim(x_shard, i * batch_size,
+                jax.lax.dynamic_slice_in_dim(xp, i * batch_size,
                                              batch_size), mean, std)
-            labels = jax.lax.dynamic_slice_in_dim(y_shard, i * batch_size,
+            labels = jax.lax.dynamic_slice_in_dim(yp, i * batch_size,
                                                   batch_size)
-            return acc + grad_vec(p, imgs, labels,
-                                  jnp.ones((batch_size,))), None
+            mask = (i * batch_size + jnp.arange(batch_size)
+                    < shard_len).astype(jnp.float32)
+            # mean(losses·w)·B/L per batch sums to the full-shard mean.
+            w = mask * batch_size / shard_len
+            return acc + grad_vec(p, imgs, labels, w), None
 
-        nb = shard_len // batch_size
-        dim = ravel_pytree(p)[0].size
         acc, _ = jax.lax.scan(body, jnp.zeros((dim,)), jnp.arange(nb))
-        return acc / nb
+        return acc
 
     g_star = jax.jit(shard_grad)(params)
 
@@ -158,6 +177,103 @@ def measure_snapshot(trainer, params, batch_stats, key, n_pool, batch_size,
     return out
 
 
+def measure_exact(trainer, params, batch_stats, key, n_pool, batch_size,
+                  n_pools, is_alpha):
+    """EXACT conditional (given-pool) estimator variances from per-sample
+    gradients — no Monte-Carlo draws.
+
+    For a pool of N samples with per-sample gradients ``g_i`` and batch-B
+    with-replacement draws reweighted by ``1/(N·p_i)``, the estimator's
+    conditional covariance trace is analytic::
+
+        Var(p) = (1/B)·(Σ_i ‖g_i‖²/(N²·p_i) − ‖ḡ‖²)
+
+    which lets us evaluate, on the same pools: uniform, the reference's
+    loss-proportional score, the grad-norm-bound score, AND the ORACLE
+    ``p_i ∝ ‖g_i‖`` — the provable variance minimum over ALL sampling
+    distributions (Katharopoulos & Fleuret). The oracle row bounds what
+    any importance score could ever buy at this (task, model, pool, B):
+    if oracle/uniform ≈ 1 the whole method family is capped, no matter
+    the score. Also reports the Pearson correlation of each score with
+    the true per-sample grad norm (the proxy-quality diagnostic).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from mercury_tpu.data.pipeline import normalize_images
+    from mercury_tpu.sampling.importance import (
+        importance_probs,
+        per_sample_grad_norm_bound,
+        per_sample_loss,
+    )
+
+    fwd, mean, std, x_shard, y_shard, shard_len = _snapshot_setup(
+        trainer, batch_stats)
+
+    def sample_grad(p, img, label):
+        def loss_fn(pp):
+            return per_sample_loss(fwd(pp, img[None]), label[None])[0]
+
+        return ravel_pytree(jax.grad(loss_fn)(p))[0]
+
+    def var_of(probs, gnorm_sq, gbar_sq):
+        # (1/B)(Σ ‖g_i‖²/(N²·p_i) − ‖ḡ‖²)
+        return (jnp.sum(gnorm_sq / (n_pool**2 * probs)) - gbar_sq) / batch_size
+
+    def one_pool(key):
+        slots = jax.random.choice(key, shard_len, (n_pool,), replace=False)
+        px = normalize_images(x_shard[slots], mean, std)
+        py = y_shard[slots]
+        logits = fwd(params, px)
+        losses = per_sample_loss(logits, py)
+        bound = per_sample_grad_norm_bound(logits, py)
+        g = jax.vmap(sample_grad, in_axes=(None, 0, 0))(params, px, py)
+        gn_sq = jnp.sum(g * g, axis=1)                    # ‖g_i‖² [N]
+        gn = jnp.sqrt(gn_sq)
+        gbar = jnp.mean(g, axis=0)
+        gbar_sq = jnp.sum(gbar * gbar)
+
+        p_uni = jnp.full((n_pool,), 1.0 / n_pool)
+        p_loss = importance_probs(losses, jnp.mean(losses), is_alpha)
+        p_bound = importance_probs(bound, jnp.mean(bound), is_alpha)
+        p_oracle = gn / jnp.sum(gn)
+
+        def corr(a, b):
+            a = (a - a.mean()) / (a.std() + 1e-12)
+            b = (b - b.mean()) / (b.std() + 1e-12)
+            return jnp.mean(a * b)
+
+        return (var_of(p_uni, gn_sq, gbar_sq),
+                var_of(p_loss, gn_sq, gbar_sq),
+                var_of(p_bound, gn_sq, gbar_sq),
+                var_of(p_oracle, gn_sq, gbar_sq),
+                corr(losses, gn), corr(bound, gn),
+                gn.std() / (gn.mean() + 1e-12))
+
+    keys = jax.random.split(key, n_pools)
+    vals = jax.jit(jax.vmap(one_pool))(keys)
+    v_uni, v_loss, v_bound, v_orc, c_loss, c_bound, cv = (
+        np.asarray(v, np.float64) for v in vals
+    )
+    return {
+        "var_uniform": float(v_uni.mean()),
+        "var_is_loss": float(v_loss.mean()),
+        "var_is_grad_norm": float(v_bound.mean()),
+        "var_oracle": float(v_orc.mean()),
+        "ratio_is_loss": float((v_loss / v_uni).mean()),
+        "ratio_is_grad_norm": float((v_bound / v_uni).mean()),
+        "ratio_oracle": float((v_orc / v_uni).mean()),
+        "corr_loss_gradnorm": float(c_loss.mean()),
+        "corr_bound_gradnorm": float(c_bound.mean()),
+        # Coefficient of variation of ‖g_i‖ — the quantity that CAPS the
+        # oracle: ratio_oracle ≈ (1+cv²·(1−‖ḡ‖²/E‖g‖²)⁻¹…) → 1 as cv → 0.
+        # When per-sample gradient norms concentrate, NO scalar-score
+        # importance scheme can reduce variance.
+        "gradnorm_cv": float(cv.mean()),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="smallcnn")
@@ -165,6 +281,12 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--presample-batches", type=int, default=10)
     ap.add_argument("--trials", type=int, default=256)
+    ap.add_argument("--exact", action="store_true",
+                    help="analytic given-pool variances from per-sample "
+                         "gradients (incl. the oracle bound) instead of "
+                         "Monte-Carlo draws")
+    ap.add_argument("--pools", type=int, default=8,
+                    help="pools per snapshot in --exact mode")
     ap.add_argument("--snapshots", default="0,25,50,100,200,400")
     ap.add_argument("--is-alpha", type=float, default=0.5)
     ap.add_argument("--seeds", type=int, default=3)
@@ -203,16 +325,24 @@ def main(argv=None) -> int:
                     trainer.state, ds.x_train, ds.y_train,
                     ds.shard_indices)
                 step += 1
-            res = measure_snapshot(
-                trainer, trainer.state.params,
-                trainer.state.batch_stats,
-                jax.random.key(1000 + seed), args.presample_batches *
-                args.batch_size, args.batch_size, args.trials,
-                args.is_alpha,
+            measure_args = (
+                trainer, trainer.state.params, trainer.state.batch_stats,
+                jax.random.key(1000 + seed),
+                args.presample_batches * args.batch_size, args.batch_size,
             )
-            row = {"schema": "grad-variance-v1", "model": args.model,
+            if args.exact:
+                res = measure_exact(*measure_args, args.pools,
+                                    args.is_alpha)
+                schema, nkey, nval = ("grad-variance-exact-v1", "pools",
+                                      args.pools)
+            else:
+                res = measure_snapshot(*measure_args, args.trials,
+                                       args.is_alpha)
+                schema, nkey, nval = ("grad-variance-v1", "trials",
+                                      args.trials)
+            row = {"schema": schema, "model": args.model,
                    "dataset": args.dataset, "seed": seed, "step": snap,
-                   "trials": args.trials,
+                   nkey: nval,
                    "pool": args.presample_batches * args.batch_size,
                    "batch": args.batch_size, "is_alpha": args.is_alpha}
             row.update({k: (round(v, 8) if isinstance(v, float) else v)
@@ -223,21 +353,36 @@ def main(argv=None) -> int:
             print(json.dumps(row))
 
     # Aggregate: per-snapshot mean ratio over seeds (the headline).
-    agg = {"schema": "grad-variance-v1-aggregate", "model": args.model,
+    # MC-mode rows can carry ratio None (degenerate var_uniform ≤ 0 from
+    # fp cancellation on near-interpolated tasks) — excluded, counted.
+    def mean_of(sub, field):
+        vals = [r[field] for r in sub if r.get(field) is not None]
+        return round(float(np.mean(vals)), 4) if vals else None
+
+    agg = {"schema": ("grad-variance-exact-v1-aggregate" if args.exact
+                      else "grad-variance-v1-aggregate"),
+           "model": args.model,
            "dataset": args.dataset, "seeds": args.seeds,
-           "trials": args.trials,
+           ("pools" if args.exact else "trials"):
+           (args.pools if args.exact else args.trials),
            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "by_step": {}}
     for snap in snaps:
         sub = [r for r in rows if r["step"] == snap]
-        agg["by_step"][str(snap)] = {
-            "ratio_is_loss_mean": round(float(np.mean(
-                [r["ratio_is_loss"] for r in sub])), 4),
-            "ratio_is_grad_norm_mean": round(float(np.mean(
-                [r["ratio_is_grad_norm"] for r in sub])), 4),
+        cell = {
+            "ratio_is_loss_mean": mean_of(sub, "ratio_is_loss"),
+            "ratio_is_grad_norm_mean": mean_of(sub, "ratio_is_grad_norm"),
             "var_uniform_mean": round(float(np.mean(
                 [r["var_uniform"] for r in sub])), 8),
+            "degenerate": sum(1 for r in sub
+                              if r.get("ratio_is_loss") is None),
         }
+        if args.exact:
+            cell["ratio_oracle_mean"] = mean_of(sub, "ratio_oracle")
+            cell["corr_loss_gradnorm_mean"] = mean_of(
+                sub, "corr_loss_gradnorm")
+            cell["gradnorm_cv_mean"] = mean_of(sub, "gradnorm_cv")
+        agg["by_step"][str(snap)] = cell
     with open(args.out, "a") as f:
         f.write(json.dumps(agg) + "\n")
     print(json.dumps(agg))

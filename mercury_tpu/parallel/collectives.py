@@ -242,11 +242,14 @@ def compressed_pmean_nd(
     return jnp.moveaxis(full, 0, dim)
 
 
-def wire_chunk_dim(shape: Tuple[int, ...], spec) -> int:
+def wire_chunk_dim(shape: Tuple[int, ...], spec):
     """Pick the dimension :func:`compressed_pmean_nd` should chunk along:
     the largest dim NOT claimed by a sharding spec entry (so TP/FSDP
-    shards are never split by the wire chunking), falling back to the
-    largest dim outright when every dim is claimed."""
+    shards are never split by the wire chunking). Returns ``None`` when
+    EVERY dim is claimed — chunking such a leaf would force the very
+    all-gather this path exists to avoid, so the caller should fall back
+    to a plain ``pmean`` for it (these leaves are 1-D biases/scales:
+    small enough that f32 wire cost is irrelevant)."""
     if not shape:
         return 0
     banned = set()
@@ -255,8 +258,9 @@ def wire_chunk_dim(shape: Tuple[int, ...], spec) -> int:
             if entry is not None:
                 banned.add(i)
     free = [i for i in range(len(shape)) if i not in banned]
-    pool = free if free else list(range(len(shape)))
-    return max(pool, key=lambda i: shape[i])
+    if not free:
+        return None
+    return max(free, key=lambda i: shape[i])
 
 
 def compressed_pmean_tree_sharded(
@@ -286,13 +290,16 @@ def compressed_pmean_tree_sharded(
                 "pytree structure (or None)"
             )
     keys = jax.random.split(key, len(leaves))
-    out = [
-        compressed_pmean_nd(
-            g, axis_name, axis_size, k,
-            dim=wire_chunk_dim(tuple(g.shape), sp),
-        )
-        for g, k, sp in zip(leaves, keys, spec_leaves)
-    ]
+    out = []
+    for g, k, sp in zip(leaves, keys, spec_leaves):
+        dim = wire_chunk_dim(tuple(g.shape), sp)
+        if dim is None:
+            # Every dim sharded (1-D bias under FSDP): chunking would
+            # split the shard — plain f32 pmean is cheaper and honest.
+            out.append(lax.pmean(g, axis_name))
+        else:
+            out.append(compressed_pmean_nd(g, axis_name, axis_size, k,
+                                           dim=dim))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -206,8 +206,9 @@ class TestCompressedPmeanND:
         # Unsharded: largest dim.
         assert wire_chunk_dim((64, 128), P()) == 1
         assert wire_chunk_dim((64, 128), None) == 1
-        # Fully claimed: fall back to largest.
-        assert wire_chunk_dim((16,), P("model")) == 0
+        # Fully claimed: None → the tree path falls back to plain pmean
+        # (chunking would split the shard).
+        assert wire_chunk_dim((16,), P("model")) is None
 
     def test_int8_composes_with_tp(self):
         """Trainer(tensor_parallel=2, grad_compression='int8'): the fused
@@ -244,6 +245,22 @@ class TestCompressedPmeanND:
         after = [l.sharding for l in
                  jax.tree_util.tree_leaves(tr.state.params)]
         assert before == after, "int8 wire path disturbed the TP layout"
+
+    def test_spec_tree_mismatch_raises(self):
+        """A structurally-diverged specs tree must be an ERROR, not a
+        silent fallback to largest-dim chunking (which would split the
+        sharded dims this path exists to avoid)."""
+        import pytest
+
+        from mercury_tpu.parallel.collectives import (
+            compressed_pmean_tree_sharded,
+        )
+
+        grads = {"a": jnp.zeros((4, 4)), "b": jnp.zeros((8,))}
+        specs = {"a": P(None, "model")}  # missing "b"
+        with pytest.raises(ValueError, match="specs tree"):
+            compressed_pmean_tree_sharded(grads, "data", 8,
+                                          jax.random.key(0), specs=specs)
 
     def test_int8_composes_with_fsdp(self):
         from mercury_tpu.config import TrainConfig

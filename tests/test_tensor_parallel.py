@@ -226,8 +226,9 @@ class TestMercuryISWithTP:
 
         with pytest.raises(ValueError, match="zero_sharding"):
             Trainer(self._cfg(tensor_parallel=2, zero_sharding=True))
-        with pytest.raises(ValueError, match="int8"):
-            Trainer(self._cfg(tensor_parallel=2, grad_compression="int8"))
+        # int8 × TP is no longer a rejection: the per-leaf compressed
+        # pmean composes (test_compressed_collective.py::
+        # TestCompressedPmeanND::test_int8_composes_with_tp).
         with pytest.raises(ValueError, match="transformer"):
             Trainer(self._cfg(tensor_parallel=2, model="smallcnn",
                               dataset="synthetic", augmentation="noniid"))
