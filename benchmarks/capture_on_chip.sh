@@ -18,8 +18,13 @@ run() {
 run python bench.py
 
 # 2. MFU vs batch sweep (where the pinned batch-32 shape sits on the
-#    utilization curve).
+#    utilization curve), plus two chip-filling configs the round-3 verdict
+#    asked for: large-batch ResNet-50 and a bf16 transformer (what the
+#    chip CAN reach when the workload has the FLOPs).
 run python benchmarks/mfu_sweep.py
+run python benchmarks/mfu_sweep.py --model resnet50 --batches 128,256,512
+run python benchmarks/mfu_sweep.py --model transformer \
+    --dataset synthetic_seq --batches 64,256,1024
 
 # 3. Segment-timing validation against a jax.profiler trace.
 run python benchmarks/profile_validation.py
@@ -34,5 +39,18 @@ for p in 1 2 3; do
   run python benchmarks/run.py --preset "$p" --dataset digits \
       --steps 1500 --eval-every 100 --target-acc 0.80
 done
+
+# 6. The round-4 flagship-WIN regime on chip: (a) the transformer IS cost
+#    ladder (per-step price of IS on this model family — the conversion
+#    factor for the CPU-measured steps-to-target win on
+#    synthetic_seq_hard), and (b) the time-to-target experiment itself at
+#    chip speed, 3 seeds.
+run python benchmarks/is_cost_ladder.py --model transformer \
+    --dataset synthetic_seq_hard --batch-size 16
+run python benchmarks/sample_efficiency.py --model transformer \
+    --dataset synthetic_seq_hard --arms is_loss,is_k8,uniform --seeds 3 \
+    --steps 300 --eval-every 10 --batch-size 16 --target-acc 0.995 \
+    --world-size 1 \
+    --out benchmarks/results_sample_efficiency_seq_hard_tpu.jsonl
 
 echo "== capture complete" >&2

@@ -57,12 +57,12 @@ def _snapshot_setup(trainer, batch_stats):
 
     def fwd(p, imgs):
         variables = {"params": p}
-        mutable = []
         if batch_stats:
             variables["batch_stats"] = batch_stats
-            mutable = ["batch_stats"]
-        out = model.apply(variables, imgs, train=True, mutable=mutable)
-        return out[0] if mutable else out
+            logits, _ = model.apply(variables, imgs, train=True,
+                                    mutable=["batch_stats"])
+            return logits
+        return model.apply(variables, imgs, train=True)
 
     return (fwd, ds.mean, ds.std, x_shard, y_shard,
             int(x_shard.shape[0]))
@@ -96,28 +96,31 @@ def measure_snapshot(trainer, params, batch_stats, key, n_pool, batch_size,
         return ravel_pytree(g)[0]
 
     # Full-shard mean gradient (the quantity every estimator estimates) —
-    # padded final batch with zero weights, so ALL shard_len samples
-    # contribute (the pools draw from all of them).
+    # full batches via scan plus an UNPADDED remainder batch, so ALL
+    # shard_len samples contribute (the pools draw from all of them)
+    # without zero-pad images contaminating BatchNorm batch statistics.
     def shard_grad(p):
-        nb = -(-shard_len // batch_size)
-        pad = nb * batch_size - shard_len
-        xp = jnp.pad(x_shard, [(0, pad)] + [(0, 0)] * (x_shard.ndim - 1))
-        yp = jnp.pad(y_shard, (0, pad))
+        nb = shard_len // batch_size
+        rem = shard_len - nb * batch_size
         dim = ravel_pytree(p)[0].size
 
         def body(acc, i):
             imgs = normalize_images(
-                jax.lax.dynamic_slice_in_dim(xp, i * batch_size,
+                jax.lax.dynamic_slice_in_dim(x_shard, i * batch_size,
                                              batch_size), mean, std)
-            labels = jax.lax.dynamic_slice_in_dim(yp, i * batch_size,
+            labels = jax.lax.dynamic_slice_in_dim(y_shard, i * batch_size,
                                                   batch_size)
-            mask = (i * batch_size + jnp.arange(batch_size)
-                    < shard_len).astype(jnp.float32)
-            # mean(losses·w)·B/L per batch sums to the full-shard mean.
-            w = mask * batch_size / shard_len
+            # mean(losses·w) with w = B/L per batch sums to the
+            # full-shard mean over all batches.
+            w = jnp.full((batch_size,), batch_size / shard_len)
             return acc + grad_vec(p, imgs, labels, w), None
 
         acc, _ = jax.lax.scan(body, jnp.zeros((dim,)), jnp.arange(nb))
+        if rem:
+            imgs = normalize_images(x_shard[nb * batch_size:], mean, std)
+            labels = y_shard[nb * batch_size:]
+            acc = acc + grad_vec(p, imgs, labels,
+                                 jnp.full((rem,), rem / shard_len))
         return acc
 
     g_star = jax.jit(shard_grad)(params)
@@ -237,7 +240,11 @@ def measure_exact(trainer, params, batch_stats, key, n_pool, batch_size,
         p_uni = jnp.full((n_pool,), 1.0 / n_pool)
         p_loss = importance_probs(losses, jnp.mean(losses), is_alpha)
         p_bound = importance_probs(bound, jnp.mean(bound), is_alpha)
-        p_oracle = gn / jnp.sum(gn)
+        # Floor like importance_probs: an exactly-zero gradient (saturated
+        # softmax post-interpolation) would give 0/0 = NaN in var_of; its
+        # true contribution is 0, which the floor preserves (gn² ≪ floor).
+        gn_floored = jnp.maximum(gn, 1e-12)
+        p_oracle = gn_floored / jnp.sum(gn_floored)
 
         def corr(a, b):
             a = (a - a.mean()) / (a.std() + 1e-12)
@@ -290,6 +297,8 @@ def main(argv=None) -> int:
     ap.add_argument("--snapshots", default="0,25,50,100,200,400")
     ap.add_argument("--is-alpha", type=float, default=0.5)
     ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--seed-base", type=int, default=0,
+                    help="first seed (resume a partially-captured sweep)")
     ap.add_argument("--compute-dtype", default="float32")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "results_grad_variance.jsonl"))
@@ -303,7 +312,7 @@ def main(argv=None) -> int:
 
     snaps = sorted({int(s) for s in args.snapshots.split(",")})
     rows = []
-    for seed in range(args.seeds):
+    for seed in range(args.seed_base, args.seed_base + args.seeds):
         config = TrainConfig(
             model=args.model, dataset=args.dataset, world_size=1,
             batch_size=args.batch_size,
@@ -363,6 +372,7 @@ def main(argv=None) -> int:
                       else "grad-variance-v1-aggregate"),
            "model": args.model,
            "dataset": args.dataset, "seeds": args.seeds,
+           "seed_base": args.seed_base,
            ("pools" if args.exact else "trials"):
            (args.pools if args.exact else args.trials),
            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

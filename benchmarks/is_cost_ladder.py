@@ -43,7 +43,8 @@ def build(args, scan_steps, **overrides):
 
     config = TrainConfig(
         model=args.model,
-        dataset="synthetic",
+        dataset=args.dataset,
+        augmentation=("noniid" if args.dataset == "synthetic" else "none"),
         world_size=1,
         batch_size=args.batch_size,
         steps_per_epoch=args.steps * args.scan_calls * scan_steps + 64,
@@ -82,6 +83,9 @@ def measure(trainer, args) -> float:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--dataset", default="synthetic",
+                    help="synthetic (CIFAR-shaped) or synthetic_seq[_hard] "
+                         "for the transformer family")
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--scan", type=int, default=25)
@@ -125,6 +129,7 @@ def main(argv=None) -> int:
     record = {
         "schema": "is_cost_ladder_v1",
         "model": args.model,
+        "dataset": args.dataset,
         "batch_size": args.batch_size,
         "scan_steps": args.scan,
         "platform": dev.platform,

@@ -46,7 +46,8 @@ def measure(batch: int, args) -> dict:
 
     config = TrainConfig(
         model=args.model,
-        dataset="synthetic",
+        dataset=args.dataset,
+        augmentation="noniid" if args.dataset == "synthetic" else "none",
         world_size=1,
         batch_size=batch,
         use_importance_sampling=False,
@@ -91,6 +92,9 @@ def measure(batch: int, args) -> dict:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--dataset", default="synthetic",
+                    help="synthetic (CIFAR-shaped) or synthetic_seq for "
+                         "the transformer family")
     ap.add_argument("--batches", default="32,128,512,1024")
     ap.add_argument("--scan", type=int, default=25)
     ap.add_argument("--calls", type=int, default=6)

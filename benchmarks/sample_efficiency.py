@@ -64,9 +64,12 @@ def run_arm(label: str, args, seed: int, **overrides) -> dict:
         seed=seed,
         scan_steps=scan,
     )
-    if args.dataset == "digits":
+    if args.dataset in ("digits", "digits_imb"):
         # Handwritten digits: horizontal flips/crops destroy class
         # identity (6 vs 9); normalize-only is the honest pipeline.
+        base_kw["augmentation"] = "none"
+    if args.dataset.startswith("synthetic_seq"):
+        # Sequence data: image augmentation does not apply.
         base_kw["augmentation"] = "none"
     base_kw.update(overrides)  # arm overrides win (e.g. a smaller pool)
     config = TrainConfig(**base_kw)
@@ -143,6 +146,8 @@ def main(argv=None) -> int:
     # of 600): early enough that arms differ, late enough not to saturate.
     ap.add_argument("--target-acc", type=float, default=0.85)
     ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--seed-base", type=int, default=0,
+                    help="first seed (resume a partially-captured sweep)")
     ap.add_argument("--metric", default="acc", choices=["acc", "rare_acc"],
                     help="crossing metric: aggregate test accuracy, or "
                          "mean per-class accuracy over --rare-classes "
@@ -191,7 +196,7 @@ def main(argv=None) -> int:
     else:
         arm_defs = all_arm_defs[:3]
     per_seed = []
-    for seed in range(args.seeds):
+    for seed in range(args.seed_base, args.seed_base + args.seeds):
         arms = {
             label: run_arm(label, args, seed, **ov) for label, ov in arm_defs
         }
@@ -230,6 +235,7 @@ def main(argv=None) -> int:
     agg = {"schema": "v2-aggregate", "model": args.model,
            "dataset": args.dataset, "steps": args.steps,
            "target_acc": args.target_acc, "seeds": args.seeds,
+           "seed_base": args.seed_base,
            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "arms": {}}
     for label, _ in arm_defs:

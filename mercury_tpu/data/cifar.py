@@ -163,6 +163,7 @@ def synthetic_sequences(
     seq_len: int = 32,
     feature_dim: int = 16,
     seed: int = 0,
+    difficulty: str = "uniform",
 ) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
     """Deterministic learnable sequence dataset ``[N, T, F]`` float32 — a
     stand-in for the speech/audio workloads the reference's ``MyLSTM``
@@ -171,6 +172,18 @@ def synthetic_sequences(
     Each class is a fixed random frequency/phase pattern per feature
     channel; samples add per-sample noise at varying scale so importance
     sampling has signal.
+
+    ``difficulty="hard_minority"`` (the round-4 flagship experiment task):
+    85% of samples carry the class signal across the whole sequence; 15%
+    carry it ONLY in the final 6 timesteps (zero elsewhere) at reduced
+    amplitude — clean labels, fully learnable (the signal is
+    deterministic), but structurally harder: the model must attend to a
+    narrow window instead of pooling the whole sequence. The easy bulk
+    interpolates quickly (per-sample gradients collapse there — measured,
+    ``results_grad_variance.jsonl``), after which the minority carries
+    essentially all remaining gradient signal: the regime where
+    loss-proportional selection (``pytorch_collab.py:89-117``) should pay
+    and uniform sampling wastes ~85% of each batch.
     """
     rng = np.random.default_rng(seed)
     freqs = rng.uniform(0.5, 4.0, (num_classes, feature_dim)).astype(np.float32)
@@ -183,7 +196,16 @@ def synthetic_sequences(
         base = np.sin(
             2 * np.pi * freqs[y][:, None, :] * t / seq_len + phases[y][:, None, :]
         )  # [n, T, F]
-        noise_scale = local.uniform(0.2, 1.0, (n, 1, 1)).astype(np.float32)
+        if difficulty == "hard_minority":
+            hard = local.random(n) < 0.15
+            win = max(seq_len // 5, 2)
+            window = (np.arange(seq_len) >= seq_len - win)[None, :, None]
+            keep = np.where(hard[:, None, None], window, True)
+            base = np.where(keep, base, 0.0)
+            base = np.where(hard[:, None, None], 0.6 * base, base)
+            noise_scale = np.full((n, 1, 1), 0.25, np.float32)
+        else:
+            noise_scale = local.uniform(0.2, 1.0, (n, 1, 1)).astype(np.float32)
         noise = local.normal(0, 1, (n, seq_len, feature_dim)).astype(np.float32)
         return (base + noise_scale * noise).astype(np.float32), y
 
@@ -297,10 +319,12 @@ def load_dataset(
             "synthetic": False,
         }
 
-    if name == "synthetic_seq":
+    if name in ("synthetic_seq", "synthetic_seq_hard"):
         num_classes = 10
         train, test = synthetic_sequences(
-            num_classes, synthetic_train_size, synthetic_test_size, seed=seed
+            num_classes, synthetic_train_size, synthetic_test_size, seed=seed,
+            difficulty=("hard_minority" if name == "synthetic_seq_hard"
+                        else "uniform"),
         )
         # Sequences are already float; normalization is identity.
         return train, test, {

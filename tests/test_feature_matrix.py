@@ -41,18 +41,33 @@ COMBOS = {
     # Round 3: int8 x ZeRO (both wire phases compressed) under scan.
     "int8+zero+scan": dict(grad_compression="int8", zero_sharding=True,
                            scan_steps=2),
+    # Round 4: FSDP (fsdp_parallel — params GSPMD-sharded over a second
+    # mesh axis) through the rest of the matrix. world_size=2×fsdp=2 on
+    # the 8-device pool; the Trainer builds its own dp×fsdp mesh.
+    "fsdp+cadence": dict(fsdp_parallel=2, world_size=2,
+                         score_refresh_every=2),
+    "fsdp+int8": dict(fsdp_parallel=2, world_size=2,
+                      grad_compression="int8"),
+    "fsdp+scan": dict(fsdp_parallel=2, world_size=2, scan_steps=2),
+    "fsdp+accum": dict(fsdp_parallel=2, world_size=2, grad_accum_steps=2),
+    "fsdp+pipelined": dict(fsdp_parallel=2, world_size=2,
+                           pipelined_scoring=True),
+    "fsdp+groupwise": dict(fsdp_parallel=2, world_size=2,
+                           sampler="groupwise"),
 }
 
 
 @pytest.mark.parametrize("name", sorted(COMBOS))
 def test_combo_trains_finite(name):
-    cfg = TrainConfig(
+    kw = dict(
         model="smallcnn", dataset="synthetic", world_size=W, batch_size=4,
         presample_batches=2, steps_per_epoch=6, num_epochs=1,
         eval_every=0, log_every=0, compute_dtype="float32", seed=0,
-        **COMBOS[name],
     )
-    tr = Trainer(cfg, mesh=host_cpu_mesh(W))
+    kw.update(COMBOS[name])  # combo overrides win (fsdp rows set world_size)
+    cfg = TrainConfig(**kw)
+    tr = Trainer(cfg, mesh=(None if cfg.fsdp_parallel > 1
+                            else host_cpu_mesh(W)))
     step_fn = tr.train_step_many or tr.train_step
     steps = 6 // max(cfg.scan_steps, 1)
     for _ in range(steps):
