@@ -180,6 +180,19 @@ def measure_snapshot(trainer, params, batch_stats, key, n_pool, batch_size,
     return out
 
 
+def conditional_variance(probs, gnorm_sq, gbar_sq, n_pool, batch_size):
+    """Trace of the conditional (given-pool) covariance of the batch-B
+    with-replacement IS estimator ``mean_B(g_i/(N·p_i))``::
+
+        Var(p) = (1/B)·(Σ_i ‖g_i‖²/(N²·p_i) − ‖ḡ‖²)
+
+    Exact for any sampling distribution ``p`` (pinned against brute-force
+    enumeration in ``tests/test_grad_variance_math.py``)."""
+    import jax.numpy as jnp
+
+    return (jnp.sum(gnorm_sq / (n_pool**2 * probs)) - gbar_sq) / batch_size
+
+
 def measure_exact(trainer, params, batch_stats, key, n_pool, batch_size,
                   n_pools, is_alpha):
     """EXACT conditional (given-pool) estimator variances from per-sample
@@ -221,8 +234,8 @@ def measure_exact(trainer, params, batch_stats, key, n_pool, batch_size,
         return ravel_pytree(jax.grad(loss_fn)(p))[0]
 
     def var_of(probs, gnorm_sq, gbar_sq):
-        # (1/B)(Σ ‖g_i‖²/(N²·p_i) − ‖ḡ‖²)
-        return (jnp.sum(gnorm_sq / (n_pool**2 * probs)) - gbar_sq) / batch_size
+        return conditional_variance(probs, gnorm_sq, gbar_sq, n_pool,
+                                    batch_size)
 
     def one_pool(key):
         slots = jax.random.choice(key, shard_len, (n_pool,), replace=False)
