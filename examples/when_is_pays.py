@@ -1,0 +1,94 @@
+"""The measure-then-decide workflow for the flagship algorithm.
+
+Importance sampling costs a pool-scoring forward every step (or every
+K-th with cadence). Whether it can EVER pay that back is a property of
+the (task, model) pair — and it's measurable up front, before you buy
+anything: the oracle variance ratio from ``benchmarks/grad_variance.py``
+bounds every possible importance score (BASELINE.md, "The mechanism,
+measured").
+
+This example runs the decision end-to-end on two small tasks:
+
+1. ``digits`` + smallcnn       — CNN regime: oracle ≈ 1 → run uniform
+                                 (or IS at cadence K=8 if you want the
+                                 reference semantics cheaply);
+2. ``synthetic_seq_hard`` +    — win regime: loss-score ratio ≪ 1 →
+   transformer                   run IS with fresh scores (K=1), it
+                                 reaches the target in ~2× fewer steps.
+
+Run (8 virtual devices, CPU; a few minutes — the per-sample-gradient
+probe dominates):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/when_is_pays.py
+"""
+
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "benchmarks"))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from mercury_tpu.config import TrainConfig  # noqa: E402
+from mercury_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mercury_tpu.train.trainer import Trainer  # noqa: E402
+
+from grad_variance import measure_exact  # noqa: E402
+
+
+def probe(model, dataset, warm_steps=100, batch=16, pool_batches=10):
+    """Train uniformly for ``warm_steps`` (past the easy-bulk transient),
+    then measure the exact per-pool estimator variances at those params."""
+    cfg = TrainConfig(
+        model=model, dataset=dataset, world_size=1, batch_size=batch,
+        presample_batches=pool_batches, use_importance_sampling=False,
+        augmentation="none", batch_norm="local",
+        steps_per_epoch=max(warm_steps, 1), num_epochs=1,
+        eval_every=0, log_every=0, compute_dtype="float32", seed=0,
+    )
+    tr = Trainer(cfg, mesh=make_mesh(1, cfg.mesh_axis))
+    for _ in range(warm_steps):
+        tr.state, _ = tr.train_step(
+            tr.state, tr.dataset.x_train, tr.dataset.y_train,
+            tr.dataset.shard_indices)
+    return measure_exact(tr, tr.state.params, tr.state.batch_stats,
+                         jax.random.key(7), pool_batches * batch, batch,
+                         n_pools=4, is_alpha=0.5)
+
+
+def decide(res):
+    if res["ratio_oracle"] > 0.8:
+        return ("uniform (or IS at score_refresh_every=8): even the "
+                "oracle can't reduce variance here")
+    if res["ratio_is_loss"] < 0.5:
+        return ("IS with fresh scores (score_refresh_every=1): the loss "
+                "score captures most of the oracle's win")
+    if res["ratio_is_grad_norm"] < 0.5:
+        return ("IS with importance_score='grad_norm' (already measured "
+                f"here: ratio {res['ratio_is_grad_norm']:.3f}) — the "
+                "loss score misses the oracle's headroom but the "
+                "grad-norm bound captures it")
+    return ("oracle headroom exists but neither implementable score "
+            "captures it — stay uniform")
+
+
+def main():
+    for model, dataset in (("smallcnn", "digits"),
+                           ("transformer", "synthetic_seq_hard")):
+        res = probe(model, dataset)
+        print(f"\n{model} on {dataset} (after 100 uniform steps):")
+        print(f"  oracle var ratio   {res['ratio_oracle']:.3f}   "
+              f"(best ANY score could do)")
+        print(f"  loss-score ratio   {res['ratio_is_loss']:.3f}   "
+              f"(what the flagship achieves)")
+        print(f"  cv(per-sample ‖g‖) {res['gradnorm_cv']:.2f}, "
+              f"corr(loss, ‖g‖) {res['corr_loss_gradnorm']:+.2f}")
+        print(f"  → {decide(res)}")
+
+
+if __name__ == "__main__":
+    main()
