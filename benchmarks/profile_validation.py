@@ -3,8 +3,10 @@
 
 Two independent views of the same workload:
 
-1. ``timing_breakdown`` — the reference-comparable five segments
-   (separately-jitted sub-programs, host-fenced medians);
+1. ``timing_breakdown`` — the reference-comparable five segments plus
+   the raw fwd+bwd median ``fb_time`` (separately-jitted sub-programs,
+   host-fenced medians; ``bp_time`` is fb−ff clamped at 0, so ``fb_time``
+   keeps a clamped zero diagnosable);
 2. a ``jax.profiler`` trace around a burst of fused steps, whose
    device-side total runtime is read back from the trace's .xplane
    protobuf (sum of XLA op durations on the device plane).
@@ -13,10 +15,16 @@ Consistency checks recorded in the artifact:
 
 - the breakdown's fused ``step_time`` should bracket the trace-derived
   per-step device time from above (host fence ≥ device busy time);
-- the parts (is + ff + bp + sync) should sum to ≥ the fused whole
-  (the documented fusion/overlap win — parts overlap inside one program);
-- the trace file must exist and be non-trivial (the hook works end to
-  end, which is what the reference's ``time.time()`` pairs cannot give).
+- ``parts_over_fused_ratio`` (is+ff+bp+sync vs the fused whole) is
+  recorded as DATA, not a pass/fail claim: the fused step also carries
+  work no segment isolates (augmentation, gathers, the draw), so the
+  ratio can be < 1 where that work dominates and > 1 where segment
+  overlap dominates — which side, per platform, is exactly what this
+  artifact documents;
+- the trace file must exist and parse (the hook works end to end, which
+  is what the reference's ``time.time()`` pairs cannot give), and the
+  bp segment must be nonzero (a clamped fb−ff means a degenerate
+  measurement).
 
 Usage (real chip)::
 
@@ -82,18 +90,28 @@ def device_step_seconds_from_trace(trace_dir: str, n_steps: int):
     Schema (tsl xplane.proto): XSpace.planes=1 → XPlane{name=2, lines=3}
     → XLine{events=4} → XEvent{duration_ps=3}. The busiest line's summed
     event durations per device plane approximates device busy time (an
-    op-stream line is sequential; other lines overlap it). Returns None
-    when no device plane exists (CPU traces) or parsing fails."""
+    op-stream line is sequential; other lines overlap it).
+
+    Returns ``(tpu_step_s, size, any_plane_step_s, parsed_ok)``: the
+    first is None when no TPU device plane exists (CPU traces) or parsing
+    fails; the third is the busiest line of ANY plane — meaningless as
+    "device busy" semantics, but non-None on a CPU trace; ``parsed_ok``
+    is True when the walker traversed at least one plane without error
+    (distinguishes "trace of all-zero durations" from "parse failed"),
+    so the wire format is validated end-to-end before a chip window
+    spends tunnel time on it."""
     paths = sorted(glob.glob(os.path.join(
         trace_dir, "**", "*.xplane.pb"), recursive=True))
     if not paths:
-        return None, None
+        return None, None, None, False
     path = paths[-1]
     size = os.path.getsize(path)
     try:
         with open(path, "rb") as f:
             space = f.read()
         busiest_ps = 0
+        busiest_any_ps = 0
+        planes_seen = 0
         for fno, wt, plane in _fields(space):
             if fno != 1 or wt != 2:
                 continue
@@ -110,14 +128,20 @@ def device_step_seconds_from_trace(trace_dir: str, n_steps: int):
                                 if efno == 3 and ewt == 0:
                                     total += eval_
                     line_sums.append(total)
+            planes_seen += 1
+            if line_sums:
+                busiest_any_ps = max(busiest_any_ps, max(line_sums))
             if b"TPU" in name and b"device" in name.lower() and line_sums:
                 busiest_ps = max(busiest_ps, max(line_sums))
-        if busiest_ps:
-            return busiest_ps / 1e12 / n_steps, size
+        return (busiest_ps / 1e12 / n_steps if busiest_ps else None,
+                size,
+                busiest_any_ps / 1e12 / n_steps if busiest_any_ps
+                else None,
+                planes_seen > 0)
     except Exception as e:  # schema drift — not fatal
         print(f"# xplane parse failed: {type(e).__name__}: {e}",
               file=sys.stderr)
-    return None, size
+    return None, size, None, False
 
 
 def main(argv=None) -> int:
@@ -161,29 +185,40 @@ def main(argv=None) -> int:
                 trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
         np.asarray(m["train/loss"])
 
-    dev_step_s, trace_bytes = device_step_seconds_from_trace(
+    (dev_step_s, trace_bytes, any_step_s,
+     parsed_ok) = device_step_seconds_from_trace(
         args.trace_dir, args.trace_steps)
 
     parts = sum(breakdown[k] for k in
                 ("is_time", "ff_time", "bp_time", "sync_time"))
     checks = {
         "trace_captured": bool(trace_bytes),
-        "parts_sum_geq_fused": parts >= breakdown["step_time"] * 0.95,
+        "xplane_parse_works": parsed_ok,
+        "bp_segment_nonzero": breakdown["bp_time"] > 0,
         "fused_geq_device_busy": (
             None if dev_step_s is None
             else breakdown["step_time"] >= dev_step_s * 0.5
         ),
     }
     record = {
-        "schema": "profile_validation_v1",
+        # v2: segment sub-programs are jit-cached across iterations (v1
+        # re-wrapped per call, so its segment rows measured tracing);
+        # parts-vs-fused is informational data, not a check.
+        "schema": "profile_validation_v2",
         "model": args.model,
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "breakdown_ms": {k: round(v * 1e3, 3) for k, v in breakdown.items()},
         "parts_sum_ms": round(parts * 1e3, 3),
+        "parts_over_fused_ratio": round(
+            parts / breakdown["step_time"], 3),
         "trace_device_step_ms": (round(dev_step_s * 1e3, 3)
                                  if dev_step_s else None),
+        # Busiest line of ANY plane: validates the xplane walker on CPU
+        # traces (no "device busy" semantics off-TPU).
+        "trace_any_plane_step_ms": (round(any_step_s * 1e3, 3)
+                                    if any_step_s else None),
         "trace_bytes": trace_bytes,
         "checks": checks,
     }

@@ -10,9 +10,12 @@ the real scoring cost lands elsewhere (``:139-142``, SURVEY.md §5).
 
 A fused XLA step has no host-visible internal boundaries, so segment
 attribution here times **separately-jitted sub-programs** with
-``block_until_ready`` fences — comparable numbers, honestly labeled as
-estimates (the fused step overlaps segments, so the parts usually sum to
-MORE than the fused whole; that gap is the fusion/overlap win).
+device fences — comparable numbers, honestly labeled as estimates. The
+parts-vs-fused relationship is DATA, not an invariant: segment overlap
+inside the fused program pushes the sum above the whole, while fused-only
+work no segment isolates (augmentation, gathers, the draw) pushes it
+below — the measured ratio per platform is recorded by
+``benchmarks/profile_validation.py``.
 
 For real kernel-level traces use :func:`trace` (``jax.profiler`` wrapper),
 the TPU-native answer to the reference's ``time.time()`` pairs.
@@ -83,9 +86,13 @@ def timing_breakdown(trainer, iters: int = 10) -> Dict[str, float]:
 
     # BN may psum over the mesh axis — run segments under a trivial
     # shard_map so the axis is bound (replicated inputs, same math).
-    def _wrap(fn, *args):
+    # Each sub-program is wrapped ONCE: a fresh jit(shard_map(...)) per
+    # timed call would retrace every iteration and the "segment time"
+    # would measure tracing, not compute (the bug behind the round-4
+    # ff>fused artifact rows).
+    def _wrap(fn):
         return jax.jit(shard_map(fn, mesh=mesh, in_specs=P(), out_specs=P(),
-                                 check_vma=False))(*args)
+                                 check_vma=False))
 
     def score_fn(images, labels):
         return jnp.sum(_fwd(images, labels))
@@ -116,10 +123,14 @@ def timing_breakdown(trainer, iters: int = 10) -> Dict[str, float]:
             lambda a, b: a + jnp.sum(b), meaned, jnp.zeros(())
         )
 
-    is_t = _timeit(lambda: _wrap(score_fn, pool.image, pool.label), iters)
-    ff_t = _timeit(lambda: _wrap(train_fwd_fn, batch.image, batch.label), iters)
-    fb_t = _timeit(lambda: _wrap(fwd_bwd_fn, batch.image, batch.label), iters)
-    sync_t = _timeit(lambda: _wrap(sync_fn), iters)
+    score_j = _wrap(score_fn)
+    train_fwd_j = _wrap(train_fwd_fn)
+    fwd_bwd_j = _wrap(fwd_bwd_fn)
+    sync_j = _wrap(sync_fn)
+    is_t = _timeit(lambda: score_j(pool.image, pool.label), iters)
+    ff_t = _timeit(lambda: train_fwd_j(batch.image, batch.label), iters)
+    fb_t = _timeit(lambda: fwd_bwd_j(batch.image, batch.label), iters)
+    sync_t = _timeit(lambda: sync_j(), iters)
 
     def fused():
         state, metrics = trainer.train_step(
@@ -134,6 +145,10 @@ def timing_breakdown(trainer, iters: int = 10) -> Dict[str, float]:
         "step_time": step_t,
         "ff_time": ff_t,
         "bp_time": max(fb_t - ff_t, 0.0),
+        # Raw forward+backward median: bp_time is fb−ff clamped at 0, so
+        # a contended host can zero it (two noisy medians); fb_time keeps
+        # the degenerate case diagnosable in recorded artifacts.
+        "fb_time": fb_t,
         "is_time": is_t,
         "sync_time": sync_t,
     }

@@ -171,9 +171,11 @@ class TestProfile:
 
         tr = Trainer(tiny(), mesh=mesh)
         out = timing_breakdown(tr, iters=2)
-        # The reference's five named segments (pytorch_collab.py:170-178).
-        assert set(out) == {"step_time", "ff_time", "bp_time", "is_time",
-                            "sync_time"}
+        # The reference's five named segments (pytorch_collab.py:170-178),
+        # plus the raw fwd+bwd median that keeps a clamped-to-zero bp_time
+        # diagnosable.
+        assert set(out) == {"step_time", "ff_time", "bp_time", "fb_time",
+                            "is_time", "sync_time"}
         assert all(np.isfinite(v) and v >= 0 for v in out.values())
         assert out["step_time"] > 0
 
