@@ -125,7 +125,10 @@ class TrainConfig:
     # scores. The 1/(N·p) reweighting still matches the distribution the
     # batch was ACTUALLY drawn from, so the estimator stays unbiased for
     # the cached scores' selection. 1 = reference behavior (fresh pool
-    # every step).
+    # every step). Measured guidance (BASELINE.md): where IS is benefit-
+    # neutral (CNN/image regime) K=8 prices it at 0.79x uniform; in the
+    # win regime (heavy-tailed gradient norms, e.g. transformers past the
+    # easy bulk) stale scores give the step advantage back — keep K=1.
     score_refresh_every: int = 1
     # Pipelined scoring (pool sampler only): step t trains on the batch
     # selected at step t-1 and scores the NEXT pool with the same params —
