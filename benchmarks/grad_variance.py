@@ -42,30 +42,16 @@ import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
 import numpy as np  # noqa: E402
 
 
-def _snapshot_setup(trainer, batch_stats):
-    """Shared fixture for both measurement modes: the worker's shard
-    arrays and the scoring forward (train mode, running stats discarded —
-    the step's scorer, train/step.py). One definition so the MC and
-    analytic modes cannot drift."""
-    import jax.numpy as jnp
-
-    ds = trainer.dataset
-    model = trainer.model
-    shard = np.asarray(ds.shard_indices[0])
-    x_shard = jnp.asarray(np.asarray(ds.x_train)[shard])
-    y_shard = jnp.asarray(np.asarray(ds.y_train)[shard])
-
-    def fwd(p, imgs):
-        variables = {"params": p}
-        if batch_stats:
-            variables["batch_stats"] = batch_stats
-            logits, _ = model.apply(variables, imgs, train=True,
-                                    mutable=["batch_stats"])
-            return logits
-        return model.apply(variables, imgs, train=True)
-
-    return (fwd, ds.mean, ds.std, x_shard, y_shard,
-            int(x_shard.shape[0]))
+# The exact-mode probe is the PACKAGE's public measure-then-decide API
+# (mercury_tpu/analysis.py, promoted there per the round-4 verdict); this
+# benchmark drives it over training snapshots and adds the Monte-Carlo
+# cross-check mode. Both modes share _snapshot_setup so they cannot drift,
+# and both report ratio_* as ratios of (pool-)mean variances — schema v2;
+# the v1 exact rows reported means of per-pool ratios (Jensen gap).
+from mercury_tpu.analysis import (  # noqa: E402
+    _snapshot_setup,
+    exact_variance_probe as measure_exact,
+)
 
 
 def measure_snapshot(trainer, params, batch_stats, key, n_pool, batch_size,
@@ -180,120 +166,6 @@ def measure_snapshot(trainer, params, batch_stats, key, n_pool, batch_size,
     return out
 
 
-def conditional_variance(probs, gnorm_sq, gbar_sq, n_pool, batch_size):
-    """Trace of the conditional (given-pool) covariance of the batch-B
-    with-replacement IS estimator ``mean_B(g_i/(N·p_i))``::
-
-        Var(p) = (1/B)·(Σ_i ‖g_i‖²/(N²·p_i) − ‖ḡ‖²)
-
-    Exact for any sampling distribution ``p`` (pinned against brute-force
-    enumeration in ``tests/test_grad_variance_math.py``)."""
-    import jax.numpy as jnp
-
-    return (jnp.sum(gnorm_sq / (n_pool**2 * probs)) - gbar_sq) / batch_size
-
-
-def measure_exact(trainer, params, batch_stats, key, n_pool, batch_size,
-                  n_pools, is_alpha):
-    """EXACT conditional (given-pool) estimator variances from per-sample
-    gradients — no Monte-Carlo draws.
-
-    For a pool of N samples with per-sample gradients ``g_i`` and batch-B
-    with-replacement draws reweighted by ``1/(N·p_i)``, the estimator's
-    conditional covariance trace is analytic::
-
-        Var(p) = (1/B)·(Σ_i ‖g_i‖²/(N²·p_i) − ‖ḡ‖²)
-
-    which lets us evaluate, on the same pools: uniform, the reference's
-    loss-proportional score, the grad-norm-bound score, AND the ORACLE
-    ``p_i ∝ ‖g_i‖`` — the provable variance minimum over ALL sampling
-    distributions (Katharopoulos & Fleuret). The oracle row bounds what
-    any importance score could ever buy at this (task, model, pool, B):
-    if oracle/uniform ≈ 1 the whole method family is capped, no matter
-    the score. Also reports the Pearson correlation of each score with
-    the true per-sample grad norm (the proxy-quality diagnostic).
-    """
-    import jax
-    import jax.numpy as jnp
-    from jax.flatten_util import ravel_pytree
-
-    from mercury_tpu.data.pipeline import normalize_images
-    from mercury_tpu.sampling.importance import (
-        importance_probs,
-        per_sample_grad_norm_bound,
-        per_sample_loss,
-    )
-
-    fwd, mean, std, x_shard, y_shard, shard_len = _snapshot_setup(
-        trainer, batch_stats)
-
-    def sample_grad(p, img, label):
-        def loss_fn(pp):
-            return per_sample_loss(fwd(pp, img[None]), label[None])[0]
-
-        return ravel_pytree(jax.grad(loss_fn)(p))[0]
-
-    def var_of(probs, gnorm_sq, gbar_sq):
-        return conditional_variance(probs, gnorm_sq, gbar_sq, n_pool,
-                                    batch_size)
-
-    def one_pool(key):
-        slots = jax.random.choice(key, shard_len, (n_pool,), replace=False)
-        px = normalize_images(x_shard[slots], mean, std)
-        py = y_shard[slots]
-        logits = fwd(params, px)
-        losses = per_sample_loss(logits, py)
-        bound = per_sample_grad_norm_bound(logits, py)
-        g = jax.vmap(sample_grad, in_axes=(None, 0, 0))(params, px, py)
-        gn_sq = jnp.sum(g * g, axis=1)                    # ‖g_i‖² [N]
-        gn = jnp.sqrt(gn_sq)
-        gbar = jnp.mean(g, axis=0)
-        gbar_sq = jnp.sum(gbar * gbar)
-
-        p_uni = jnp.full((n_pool,), 1.0 / n_pool)
-        p_loss = importance_probs(losses, jnp.mean(losses), is_alpha)
-        p_bound = importance_probs(bound, jnp.mean(bound), is_alpha)
-        # Floor like importance_probs: an exactly-zero gradient (saturated
-        # softmax post-interpolation) would give 0/0 = NaN in var_of; its
-        # true contribution is 0, which the floor preserves (gn² ≪ floor).
-        gn_floored = jnp.maximum(gn, 1e-12)
-        p_oracle = gn_floored / jnp.sum(gn_floored)
-
-        def corr(a, b):
-            a = (a - a.mean()) / (a.std() + 1e-12)
-            b = (b - b.mean()) / (b.std() + 1e-12)
-            return jnp.mean(a * b)
-
-        return (var_of(p_uni, gn_sq, gbar_sq),
-                var_of(p_loss, gn_sq, gbar_sq),
-                var_of(p_bound, gn_sq, gbar_sq),
-                var_of(p_oracle, gn_sq, gbar_sq),
-                corr(losses, gn), corr(bound, gn),
-                gn.std() / (gn.mean() + 1e-12))
-
-    keys = jax.random.split(key, n_pools)
-    vals = jax.jit(jax.vmap(one_pool))(keys)
-    v_uni, v_loss, v_bound, v_orc, c_loss, c_bound, cv = (
-        np.asarray(v, np.float64) for v in vals
-    )
-    return {
-        "var_uniform": float(v_uni.mean()),
-        "var_is_loss": float(v_loss.mean()),
-        "var_is_grad_norm": float(v_bound.mean()),
-        "var_oracle": float(v_orc.mean()),
-        "ratio_is_loss": float((v_loss / v_uni).mean()),
-        "ratio_is_grad_norm": float((v_bound / v_uni).mean()),
-        "ratio_oracle": float((v_orc / v_uni).mean()),
-        "corr_loss_gradnorm": float(c_loss.mean()),
-        "corr_bound_gradnorm": float(c_bound.mean()),
-        # Coefficient of variation of ‖g_i‖ — the quantity that CAPS the
-        # oracle: ratio_oracle ≈ (1+cv²·(1−‖ḡ‖²/E‖g‖²)⁻¹…) → 1 as cv → 0.
-        # When per-sample gradient norms concentrate, NO scalar-score
-        # importance scheme can reduce variance.
-        "gradnorm_cv": float(cv.mean()),
-    }
-
-
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="smallcnn")
@@ -355,7 +227,7 @@ def main(argv=None) -> int:
             if args.exact:
                 res = measure_exact(*measure_args, args.pools,
                                     args.is_alpha)
-                schema, nkey, nval = ("grad-variance-exact-v1", "pools",
+                schema, nkey, nval = ("grad-variance-exact-v2", "pools",
                                       args.pools)
             else:
                 res = measure_snapshot(*measure_args, args.trials,
@@ -381,7 +253,7 @@ def main(argv=None) -> int:
         vals = [r[field] for r in sub if r.get(field) is not None]
         return round(float(np.mean(vals)), 4) if vals else None
 
-    agg = {"schema": ("grad-variance-exact-v1-aggregate" if args.exact
+    agg = {"schema": ("grad-variance-exact-v2-aggregate" if args.exact
                       else "grad-variance-v1-aggregate"),
            "model": args.model,
            "dataset": args.dataset, "seeds": args.seeds,

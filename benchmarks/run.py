@@ -136,6 +136,7 @@ def main(argv=None) -> int:
 
     total_train_time = time.perf_counter() - t0
     images = step * config.batch_size * config.world_size
+    backend = jax.default_backend()
     record = {
         "preset": args.preset,
         "config": dataclasses.asdict(config),
@@ -145,9 +146,17 @@ def main(argv=None) -> int:
         "time_to_target_s": (round(time_to_target, 2)
                              if time_to_target is not None else None),
         "steps_to_target": steps_to_target,
-        "images_per_sec_per_chip": round(images / total_train_time / world, 1),
+        # Resolution of steps_to_target: the target may have been crossed
+        # anywhere in the last eval window (round-4 verdict: every arm
+        # crossing at the FIRST eval discriminates nothing).
+        "eval_resolution_steps": args.eval_every,
+        # Honest name: per-DEVICE throughput on whatever backend ran.
+        # Only a backend=="tpu" row may be quoted as per-chip (the
+        # round-4 rows put CPU numbers under a per-chip field name).
+        "images_per_sec_per_device": round(
+            images / total_train_time / world, 1),
         "devices": world,
-        "backend": jax.default_backend(),
+        "backend": backend,
     }
     with open(args.out, "a") as f:
         f.write(json.dumps(record) + "\n")

@@ -64,9 +64,10 @@ def run_arm(label: str, args, seed: int, **overrides) -> dict:
         seed=seed,
         scan_steps=scan,
     )
-    if args.dataset in ("digits", "digits_imb"):
+    if args.dataset.startswith("digits"):
         # Handwritten digits: horizontal flips/crops destroy class
         # identity (6 vs 9); normalize-only is the honest pipeline.
+        # (Covers digits_seq/_imb too — sequences take no image augment.)
         base_kw["augmentation"] = "none"
     if args.dataset.startswith("synthetic_seq"):
         # Sequence data: image augmentation does not apply.

@@ -3,9 +3,9 @@
 Importance sampling costs a pool-scoring forward every step (or every
 K-th with cadence). Whether it can EVER pay that back is a property of
 the (task, model) pair — and it's measurable up front, before you buy
-anything: the oracle variance ratio from ``benchmarks/grad_variance.py``
-bounds every possible importance score (BASELINE.md, "The mechanism,
-measured").
+anything: the oracle variance ratio from
+``mercury_tpu.analysis.estimate_is_benefit`` bounds every possible
+importance score (BASELINE.md, "The mechanism, measured").
 
 This example runs the decision end-to-end on two small tasks:
 
@@ -24,62 +24,17 @@ probe dominates):
 
 import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
 
-import os
-import sys
-
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
-                                "benchmarks"))
-
-import jax  # noqa: E402
-import numpy as np  # noqa: E402
-
+from mercury_tpu.analysis import estimate_is_benefit  # noqa: E402
 from mercury_tpu.config import TrainConfig  # noqa: E402
-from mercury_tpu.parallel.mesh import make_mesh  # noqa: E402
-from mercury_tpu.train.trainer import Trainer  # noqa: E402
-
-from grad_variance import measure_exact  # noqa: E402
-
-
-def probe(model, dataset, warm_steps=100, batch=16, pool_batches=10):
-    """Train uniformly for ``warm_steps`` (past the easy-bulk transient),
-    then measure the exact per-pool estimator variances at those params."""
-    cfg = TrainConfig(
-        model=model, dataset=dataset, world_size=1, batch_size=batch,
-        presample_batches=pool_batches, use_importance_sampling=False,
-        augmentation="none", batch_norm="local",
-        steps_per_epoch=max(warm_steps, 1), num_epochs=1,
-        eval_every=0, log_every=0, compute_dtype="float32", seed=0,
-    )
-    tr = Trainer(cfg, mesh=make_mesh(1, cfg.mesh_axis))
-    for _ in range(warm_steps):
-        tr.state, _ = tr.train_step(
-            tr.state, tr.dataset.x_train, tr.dataset.y_train,
-            tr.dataset.shard_indices)
-    return measure_exact(tr, tr.state.params, tr.state.batch_stats,
-                         jax.random.key(7), pool_batches * batch, batch,
-                         n_pools=4, is_alpha=0.5)
-
-
-def decide(res):
-    if res["ratio_oracle"] > 0.8:
-        return ("uniform (or IS at score_refresh_every=8): even the "
-                "oracle can't reduce variance here")
-    if res["ratio_is_loss"] < 0.5:
-        return ("IS with fresh scores (score_refresh_every=1): the loss "
-                "score captures most of the oracle's win")
-    if res["ratio_is_grad_norm"] < 0.5:
-        return ("IS with importance_score='grad_norm' (already measured "
-                f"here: ratio {res['ratio_is_grad_norm']:.3f}) — the "
-                "loss score misses the oracle's headroom but the "
-                "grad-norm bound captures it")
-    return ("oracle headroom exists but neither implementable score "
-            "captures it — stay uniform")
 
 
 def main():
     for model, dataset in (("smallcnn", "digits"),
                            ("transformer", "synthetic_seq_hard")):
-        res = probe(model, dataset)
+        cfg = TrainConfig(model=model, dataset=dataset, world_size=1,
+                          batch_size=16, presample_batches=10,
+                          compute_dtype="float32", seed=0)
+        res = estimate_is_benefit(cfg, warm_steps=100, pools=4)
         print(f"\n{model} on {dataset} (after 100 uniform steps):")
         print(f"  oracle var ratio   {res['ratio_oracle']:.3f}   "
               f"(best ANY score could do)")
@@ -87,7 +42,7 @@ def main():
               f"(what the flagship achieves)")
         print(f"  cv(per-sample ‖g‖) {res['gradnorm_cv']:.2f}, "
               f"corr(loss, ‖g‖) {res['corr_loss_gradnorm']:+.2f}")
-        print(f"  → {decide(res)}")
+        print(f"  → {res['recommendation']}")
 
 
 if __name__ == "__main__":

@@ -12,6 +12,9 @@ Importance Sampling") as an idiomatic JAX framework:
 - ``mercury_tpu.sampling``  — the importance-sampling core: candidate scoring,
   EMA smoothing, with-replacement categorical draws, unbiased reweighting,
   and the group-wise sliding-window sampler.
+- ``mercury_tpu.analysis``  — measure-then-decide: the exact variance
+  probe (incl. the oracle bound) that predicts whether importance
+  sampling can pay on a given (task, model) before you buy it.
 - ``mercury_tpu.parallel``  — SPMD data parallelism over a ``jax.sharding.Mesh``
   with in-graph ``lax.psum`` gradient + importance-stat reduction, plus an
   explicit ``lax.ppermute`` ring allreduce.
@@ -24,3 +27,4 @@ Importance Sampling") as an idiomatic JAX framework:
 __version__ = "0.1.0"
 
 from mercury_tpu.config import TrainConfig  # noqa: F401
+from mercury_tpu.analysis import estimate_is_benefit  # noqa: F401

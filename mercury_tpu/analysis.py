@@ -1,0 +1,267 @@
+"""Measure-then-decide: will importance sampling pay on YOUR task?
+
+The flagship algorithm (``sampling/importance.py``, re-implementing the
+reference's ``pytorch_collab.py:89-117``) buys convergence speed through
+exactly one channel: drawing the train batch ∝ score and reweighting by
+``1/(N·p)`` keeps the gradient estimator unbiased while — IF the score
+correlates with per-sample gradient norm — reducing its variance. That
+"if" is a property of the (task, model) pair, and it is measurable up
+front, before paying the pool-scoring forward every step.
+
+This module exposes the probe as a public API:
+
+- :func:`estimate_is_benefit` — train uniformly for a short warm-up,
+  then compute the EXACT conditional estimator variances (no Monte-Carlo
+  draws) for uniform, the reference's loss-proportional score, the
+  grad-norm-bound score, and the ORACLE ``p_i ∝ ‖g_i‖`` — the provable
+  variance minimum over ALL sampling distributions (Katharopoulos &
+  Fleuret, ICML 2018). The oracle row bounds what any importance score
+  could ever buy: if ``ratio_oracle ≈ 1`` the whole method family is
+  capped on this task, no matter the score.
+- :func:`recommend` — the decision rule mapping those ratios to a
+  concrete ``TrainConfig`` choice (uniform / IS fresh / IS at cadence /
+  grad-norm score).
+
+Measured boundary (committed artifacts, ``benchmarks/
+results_grad_variance.jsonl``): CIFAR-style CNNs concentrate per-sample
+gradient norms (oracle ≥ 0.89 → stay uniform); post-bulk transformers on
+hard-minority sequence tasks heavy-tail them (oracle 10-15× reduction,
+loss score within ~1.4× of it → IS wins 2.0× in steps, 5/5 seeds).
+
+The variance formula itself is pinned against brute-force enumeration in
+``tests/test_grad_variance_math.py``; the MC cross-check lives in
+``benchmarks/grad_variance.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "conditional_variance",
+    "exact_variance_probe",
+    "estimate_is_benefit",
+    "recommend",
+]
+
+
+def conditional_variance(probs, gnorm_sq, gbar_sq, n_pool, batch_size):
+    """Trace of the conditional (given-pool) covariance of the batch-B
+    with-replacement IS estimator ``mean_B(g_i/(N·p_i))``::
+
+        Var(p) = (1/B)·(Σ_i ‖g_i‖²/(N²·p_i) − ‖ḡ‖²)
+
+    Exact for any sampling distribution ``p`` (pinned against brute-force
+    enumeration in ``tests/test_grad_variance_math.py``)."""
+    import jax.numpy as jnp
+
+    return (jnp.sum(gnorm_sq / (n_pool**2 * probs)) - gbar_sq) / batch_size
+
+
+def _snapshot_setup(trainer, batch_stats):
+    """Worker-shard arrays and the scoring forward (train mode, running
+    stats discarded — the step's scorer, ``train/step.py``). Shared by the
+    exact probe here and the MC cross-check in ``benchmarks/
+    grad_variance.py`` so the two modes cannot drift."""
+    import jax.numpy as jnp
+
+    ds = trainer.dataset
+    model = trainer.model
+    shard = np.asarray(ds.shard_indices[0])
+    x_shard = jnp.asarray(np.asarray(ds.x_train)[shard])
+    y_shard = jnp.asarray(np.asarray(ds.y_train)[shard])
+
+    def fwd(p, imgs):
+        variables = {"params": p}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            logits, _ = model.apply(variables, imgs, train=True,
+                                    mutable=["batch_stats"])
+            return logits
+        return model.apply(variables, imgs, train=True)
+
+    return (fwd, ds.mean, ds.std, x_shard, y_shard,
+            int(x_shard.shape[0]))
+
+
+def exact_variance_probe(trainer, params, batch_stats, key, n_pool,
+                         batch_size, n_pools, is_alpha):
+    """EXACT conditional (given-pool) estimator variances from per-sample
+    gradients — no Monte-Carlo draws.
+
+    For a pool of N samples with per-sample gradients ``g_i`` and batch-B
+    with-replacement draws reweighted by ``1/(N·p_i)``, the estimator's
+    conditional covariance trace is analytic (:func:`conditional_variance`),
+    which lets us evaluate, on the same pools: uniform, the reference's
+    loss-proportional score (``pytorch_collab.py:111-112``), the
+    grad-norm-bound score, AND the oracle ``p_i ∝ ‖g_i‖``. Also reports
+    the Pearson correlation of each score with the true per-sample grad
+    norm (the proxy-quality diagnostic) and the coefficient of variation
+    of ``‖g_i‖`` — the quantity that caps the oracle: as cv → 0 no
+    scalar-score importance scheme can reduce variance.
+
+    All ``ratio_*`` fields are ratios of POOL-MEAN variances
+    (``mean_pools(var_p) / mean_pools(var_uniform)``) — the same
+    convention as the MC mode in ``benchmarks/grad_variance.py``, so the
+    two instruments are directly comparable (a mean of per-pool ratios
+    would differ by a Jensen gap when per-pool variances vary).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from mercury_tpu.data.pipeline import normalize_images
+    from mercury_tpu.sampling.importance import (
+        importance_probs,
+        per_sample_grad_norm_bound,
+        per_sample_loss,
+    )
+
+    fwd, mean, std, x_shard, y_shard, shard_len = _snapshot_setup(
+        trainer, batch_stats)
+
+    def sample_grad(p, img, label):
+        def loss_fn(pp):
+            return per_sample_loss(fwd(pp, img[None]), label[None])[0]
+
+        return ravel_pytree(jax.grad(loss_fn)(p))[0]
+
+    def var_of(probs, gnorm_sq, gbar_sq):
+        return conditional_variance(probs, gnorm_sq, gbar_sq, n_pool,
+                                    batch_size)
+
+    def one_pool(key):
+        slots = jax.random.choice(key, shard_len, (n_pool,), replace=False)
+        px = normalize_images(x_shard[slots], mean, std)
+        py = y_shard[slots]
+        logits = fwd(params, px)
+        losses = per_sample_loss(logits, py)
+        bound = per_sample_grad_norm_bound(logits, py)
+        g = jax.vmap(sample_grad, in_axes=(None, 0, 0))(params, px, py)
+        gn_sq = jnp.sum(g * g, axis=1)                    # ‖g_i‖² [N]
+        gn = jnp.sqrt(gn_sq)
+        gbar = jnp.mean(g, axis=0)
+        gbar_sq = jnp.sum(gbar * gbar)
+
+        p_uni = jnp.full((n_pool,), 1.0 / n_pool)
+        p_loss = importance_probs(losses, jnp.mean(losses), is_alpha)
+        p_bound = importance_probs(bound, jnp.mean(bound), is_alpha)
+        # Floor like importance_probs: an exactly-zero gradient (saturated
+        # softmax post-interpolation) would give 0/0 = NaN in var_of; its
+        # true contribution is 0, which the floor preserves (gn² ≪ floor).
+        gn_floored = jnp.maximum(gn, 1e-12)
+        p_oracle = gn_floored / jnp.sum(gn_floored)
+
+        def corr(a, b):
+            a = (a - a.mean()) / (a.std() + 1e-12)
+            b = (b - b.mean()) / (b.std() + 1e-12)
+            return jnp.mean(a * b)
+
+        return (var_of(p_uni, gn_sq, gbar_sq),
+                var_of(p_loss, gn_sq, gbar_sq),
+                var_of(p_bound, gn_sq, gbar_sq),
+                var_of(p_oracle, gn_sq, gbar_sq),
+                corr(losses, gn), corr(bound, gn),
+                gn.std() / (gn.mean() + 1e-12))
+
+    keys = jax.random.split(key, n_pools)
+    vals = jax.jit(jax.vmap(one_pool))(keys)
+    v_uni, v_loss, v_bound, v_orc, c_loss, c_bound, cv = (
+        np.asarray(v, np.float64) for v in vals
+    )
+    mu_uni = float(v_uni.mean())
+    return {
+        "var_uniform": mu_uni,
+        "var_is_loss": float(v_loss.mean()),
+        "var_is_grad_norm": float(v_bound.mean()),
+        "var_oracle": float(v_orc.mean()),
+        "ratio_is_loss": float(v_loss.mean() / mu_uni),
+        "ratio_is_grad_norm": float(v_bound.mean() / mu_uni),
+        "ratio_oracle": float(v_orc.mean() / mu_uni),
+        "corr_loss_gradnorm": float(c_loss.mean()),
+        "corr_bound_gradnorm": float(c_bound.mean()),
+        "gradnorm_cv": float(cv.mean()),
+    }
+
+
+def recommend(ratios: dict) -> str:
+    """Map probe ratios to a concrete config choice (the decision rule
+    demonstrated end-to-end in ``examples/when_is_pays.py``)."""
+    if ratios["ratio_oracle"] > 0.8:
+        return ("uniform (or IS at score_refresh_every=8): even the "
+                "oracle can't reduce variance here")
+    if ratios["ratio_is_loss"] < 0.5:
+        return ("IS with fresh scores (score_refresh_every=1): the loss "
+                "score captures most of the oracle's win")
+    if ratios["ratio_is_grad_norm"] < 0.5:
+        return ("IS with importance_score='grad_norm' (measured here: "
+                f"ratio {ratios['ratio_is_grad_norm']:.3f}) — the loss "
+                "score misses the oracle's headroom but the grad-norm "
+                "bound captures it")
+    return ("oracle headroom exists but neither implementable score "
+            "captures it — stay uniform")
+
+
+def estimate_is_benefit(config, *, warm_steps: int = 100,
+                        pools: int = 4,
+                        seed: Optional[int] = None,
+                        key=None) -> dict:
+    """Will importance sampling pay on this (task, model)? Measure first.
+
+    Trains UNIFORMLY for ``warm_steps`` on ``config``'s task (past the
+    easy-bulk transient, where every estimator looks alike), then runs
+    :func:`exact_variance_probe` at those params over ``pools``
+    independent candidate pools of ``config.candidate_pool_size`` and returns the
+    ratio dict plus ``recommendation`` (:func:`recommend`).
+
+    The probe honours the config's sampling geometry (``batch_size``,
+    ``presample_batches``, ``is_alpha``) so the measured ratios apply to
+    the exact estimator the fused step would run. The trajectory is
+    forced uniform / unaugmented / W=1 regardless of the config's own
+    flags — estimators must be compared at common params, and the probe's
+    verdict is what decides whether to turn IS on.
+
+    Cost: dominated by ``pools × pool_size`` per-sample gradients (a
+    vmapped backward each) — seconds for small models, a couple of
+    minutes for ResNet-scale on CPU. Cheap relative to buying a
+    pool-scoring forward every step of a full run.
+    """
+    import dataclasses
+
+    import jax
+
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    probe_cfg = dataclasses.replace(
+        config,
+        world_size=1,
+        tensor_parallel=1,      # the probe is a single-device measurement:
+        fsdp_parallel=1,        # estimator variance is a property of the
+        zero_sharding=False,    # (task, model, pool, B) geometry, not of
+        use_importance_sampling=False,  # how the full run will shard
+        augmentation="none",
+        batch_norm="local",     # W=1: sync's psum is unbound outside shard_map
+        steps_per_epoch=max(warm_steps, 1),
+        num_epochs=1,
+        eval_every=0,
+        log_every=0,
+        **({"seed": seed} if seed is not None else {}),
+    )
+    trainer = Trainer(probe_cfg, mesh=make_mesh(1, probe_cfg.mesh_axis))
+    ds = trainer.dataset
+    for _ in range(warm_steps):
+        trainer.state, _ = trainer.train_step(
+            trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+    if key is None:
+        key = jax.random.key(probe_cfg.seed + 7)
+    out = exact_variance_probe(
+        trainer, trainer.state.params, trainer.state.batch_stats, key,
+        probe_cfg.candidate_pool_size, probe_cfg.batch_size, pools,
+        probe_cfg.is_alpha)
+    out["warm_steps"] = warm_steps
+    out["pools"] = pools
+    out["recommendation"] = recommend(out)
+    return out

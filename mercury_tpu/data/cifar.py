@@ -268,7 +268,7 @@ def load_dataset(
             "synthetic": True,
         }
 
-    if name in ("digits", "digits_imb"):
+    if name in ("digits", "digits_imb", "digits_seq", "digits_seq_imb"):
         # The one REAL image dataset guaranteed on disk in a sealed
         # environment: scikit-learn's bundled handwritten-digits set
         # (UCI ML Optical Recognition of Handwritten Digits — 1,797 real
@@ -288,18 +288,27 @@ def load_dataset(
         # them exactly when they are hard-but-learnable. Measure with
         # per-class accuracy over the rare classes
         # (``Trainer.per_class_accuracy``).
+        #
+        # ``digits_seq`` / ``digits_seq_imb``: the SAME real scans as
+        # FOUND sequence data (round-4 verdict: stress the win regime on
+        # a task the builder didn't shape). Each 8×8 scan becomes its
+        # raw length-64 scanline sequence ``[64, 1]`` — no windowing, no
+        # amplitude tuning, no constructed minority structure; whatever
+        # makes a sample hard for a sequence model is a property of the
+        # real handwriting. ``_imb`` applies the identical classes-5–9 ×
+        # 10% protocol established for the image variant (a rarity
+        # mechanism fixed BEFORE this experiment, not tuned for it).
         from sklearn.datasets import load_digits as _load_digits
 
         d = _load_digits()
-        imgs = (d.images / d.images.max() * 255.0).astype(np.uint8)
-        imgs = np.repeat(np.repeat(imgs, 4, axis=1), 4, axis=2)  # 8→32
-        imgs = np.repeat(imgs[..., None], 3, axis=-1)            # gray→RGB
+        as_seq = name.startswith("digits_seq")
+        imbalanced = name.endswith("_imb")
         labels = d.target.astype(np.int32)
         rng_d = np.random.default_rng(seed)
-        order = rng_d.permutation(len(imgs))
-        n_test = len(imgs) // 5
+        order = rng_d.permutation(len(labels))
+        n_test = len(labels) // 5
         test_idx, train_idx = order[:n_test], order[n_test:]
-        if name == "digits_imb":
+        if imbalanced:
             ytr = labels[train_idx]
             keep = np.ones(len(train_idx), bool)
             for c in range(5, 10):
@@ -307,11 +316,27 @@ def load_dataset(
                 n_keep = max(int(round(0.1 * len(idx))), 8)
                 keep[rng_d.permutation(idx)[n_keep:]] = False
             train_idx = train_idx[keep]
-        train = (imgs[train_idx], labels[train_idx])
-        test = (imgs[test_idx], labels[test_idx])
-        flat = imgs[train_idx].astype(np.float32) / 255.0
-        mean = flat.mean(axis=(0, 1, 2)).astype(np.float32)
-        std = np.maximum(flat.std(axis=(0, 1, 2)), 1e-3).astype(np.float32)
+        if as_seq:
+            # Raw scanline sequences in [0, 1]; standardized by the
+            # train split's scalar stats via the normal pipeline path
+            # (float sequences skip the /255 branch).
+            x = (d.images / d.images.max()).astype(np.float32)
+            x = x.reshape(len(x), 64, 1)
+            mean = x[train_idx].mean(keepdims=False).reshape(1)
+            std = np.maximum(x[train_idx].std(), 1e-3).reshape(1)
+            mean = mean.astype(np.float32)
+            std = std.astype(np.float32)
+            train = (x[train_idx], labels[train_idx])
+            test = (x[test_idx], labels[test_idx])
+        else:
+            imgs = (d.images / d.images.max() * 255.0).astype(np.uint8)
+            imgs = np.repeat(np.repeat(imgs, 4, axis=1), 4, axis=2)  # 8→32
+            imgs = np.repeat(imgs[..., None], 3, axis=-1)            # gray→RGB
+            train = (imgs[train_idx], labels[train_idx])
+            test = (imgs[test_idx], labels[test_idx])
+            flat = imgs[train_idx].astype(np.float32) / 255.0
+            mean = flat.mean(axis=(0, 1, 2)).astype(np.float32)
+            std = np.maximum(flat.std(axis=(0, 1, 2)), 1e-3).astype(np.float32)
         return train, test, {
             "num_classes": 10,
             "mean": mean,

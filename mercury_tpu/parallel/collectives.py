@@ -188,8 +188,12 @@ def compressed_allreduce_mean_tree(
 
 def _quantize_chunks(key: jax.Array, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-leading-chunk int8 quantization of an ND array ``[W, ...]``:
-    one scale per chunk (max-abs over every trailing axis), stochastic
-    rounding — E[q·scale] = x."""
+    ONE scale per chunk (max-abs over every trailing axis), stochastic
+    rounding — E[q·scale] = x. Coarser than the 1-D path's per-row
+    scales: still unbiased, but for a leaf with large dynamic range
+    across rows within a chunk the quantization variance is higher than
+    :func:`compressed_allreduce_mean` would give on the flattened leaf —
+    the price of keeping GSPMD-sharded leaves in their natural shape."""
     axes = tuple(range(1, x.ndim))
     scale = jnp.maximum(
         jnp.max(jnp.abs(x), axis=axes, keepdims=True), 1e-30
@@ -211,8 +215,10 @@ def compressed_pmean_nd(
     a leaf sharded over an orthogonal auto axis stays sharded through
     both phases (the all_to_all/all_gather ride the data axis; GSPMD
     partitions them per model shard). Same two-phase unbiased estimator
-    as the 1-D version: int8 + per-chunk scale on the wire, f32
-    accumulation.
+    as the 1-D version, with COARSER scale granularity: one scale per
+    wire chunk rather than per 128-element row (see
+    :func:`_quantize_chunks`), so on-wire variance is equal or higher —
+    unbiasedness is unchanged.
     """
     if axis_size == 1:
         return x

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
+from mercury_tpu.config import TrainConfig
 from mercury_tpu.data.pipeline import ShardStream, init_shard_streams, next_pool
 from mercury_tpu.parallel.pipeline import make_pp_apply
 from mercury_tpu.sampling.importance import (
@@ -83,7 +84,7 @@ def make_pp_mercury_step(
     axis: str = "pipe",
     is_alpha: float = 0.5,
     ema_alpha: float = 0.9,
-    moe_aux_weight: float = 0.01,
+    moe_aux_weight: float = TrainConfig.moe_aux_weight,
 ) -> Callable[..., Tuple[PPMercuryState, dict]]:
     """Build ``step(state, x_train, y_train) → (state, metrics)``.
 
@@ -96,8 +97,10 @@ def make_pp_mercury_step(
     MoE models compose: the Switch router's load-balancing aux loss flows
     out of the staged scan (``make_pp_apply(with_aux=True)``) and enters
     the training objective as ``moe_aux_weight × aux`` — the same term the
-    fused data-parallel step applies (``train/step.py``,
-    ``config.moe_aux_weight``). The scoring pass discards the aux (scores
+    fused data-parallel step applies (``train/step.py``). The default IS
+    ``TrainConfig.moe_aux_weight`` (one source of truth); a caller using a
+    config with a non-default value must pass ``config.moe_aux_weight``
+    explicitly — this factory takes keywords, not a ``TrainConfig``. The scoring pass discards the aux (scores
     are per-sample CE, matching ``pytorch_collab.py:102``).
     """
     pool_size = presample_batches * batch_size

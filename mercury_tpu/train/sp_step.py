@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from jax import shard_map
 
+from mercury_tpu.config import TrainConfig
 from mercury_tpu.data.pipeline import (
     ShardStream,
     init_shard_streams,
@@ -51,7 +52,7 @@ def make_dp_sp_train_step(
     mesh: Mesh,
     data_axis: str = "data",
     seq_axis: str = "seq",
-    moe_aux_weight: float = 0.01,
+    moe_aux_weight: float = TrainConfig.moe_aux_weight,
 ) -> Callable[..., Tuple[dict, tuple, jax.Array]]:
     """Build a jitted train step over a 2-D ``(data, seq)`` mesh.
 
@@ -155,7 +156,7 @@ def make_dp_sp_mercury_step(
     presample_batches: int = 10,
     is_alpha: float = 0.5,
     ema_alpha: float = 0.9,
-    moe_aux_weight: float = 0.01,
+    moe_aux_weight: float = TrainConfig.moe_aux_weight,
     data_axis: str = "data",
     seq_axis: str = "seq",
 ) -> Callable[..., Tuple["SpMercuryState", dict]]:

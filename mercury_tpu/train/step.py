@@ -168,6 +168,15 @@ def make_train_step(
         raise ValueError(f"unknown grad_compression {config.grad_compression!r}")
     compress_grads = config.grad_compression == "stochastic"
     int8_allreduce = config.grad_compression == "int8"
+    if tp_active and int8_allreduce and sharded_param_specs is None:
+        raise ValueError(
+            "grad_compression='int8' under an active auto mesh axis needs "
+            "state_out_shardings (per-leaf PartitionSpecs): without them "
+            "the wire chunker picks the largest dim, which may be the "
+            "GSPMD-sharded one — silently forcing the all-gather the "
+            "per-leaf path exists to avoid; pass state_out_shardings "
+            "(Trainer does) or drop grad_compression"
+        )
     use_groupwise = use_is and config.sampler == "groupwise"
     pipelined = use_is and config.pipelined_scoring
     zero = config.zero_sharding

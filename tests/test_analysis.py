@@ -1,0 +1,97 @@
+"""The public measure-then-decide API (``mercury_tpu/analysis.py``).
+
+Promoted from ``benchmarks/grad_variance.py`` per the round-4 verdict:
+a user should be able to ask "will IS pay on my task?" before buying the
+pool-scoring forward. The formula itself is pinned in
+``test_grad_variance_math.py``; here we exercise the end-to-end probe and
+its invariants.
+"""
+
+import numpy as np
+import pytest
+
+from mercury_tpu.analysis import estimate_is_benefit, recommend
+from mercury_tpu.config import TrainConfig
+
+
+@pytest.fixture(scope="module")
+def probe_result():
+    cfg = TrainConfig(
+        model="smallcnn", dataset="synthetic", world_size=1, batch_size=8,
+        presample_batches=4, compute_dtype="float32", seed=0,
+    )
+    return estimate_is_benefit(cfg, warm_steps=3, pools=3)
+
+
+class TestEstimateIsBenefit:
+    def test_schema(self, probe_result):
+        for k in ("var_uniform", "var_is_loss", "var_is_grad_norm",
+                  "var_oracle", "ratio_is_loss", "ratio_is_grad_norm",
+                  "ratio_oracle", "corr_loss_gradnorm",
+                  "corr_bound_gradnorm", "gradnorm_cv", "warm_steps",
+                  "pools", "recommendation"):
+            assert k in probe_result, k
+        assert probe_result["warm_steps"] == 3
+        assert probe_result["pools"] == 3
+        assert isinstance(probe_result["recommendation"], str)
+
+    def test_oracle_is_the_floor(self, probe_result):
+        """p ∝ ‖gᵢ‖ minimizes the conditional variance per pool, so with
+        the v2 ratio-of-pool-mean convention the oracle ratio bounds every
+        implementable score from below (and 1.0 — uniform — from above)."""
+        r = probe_result
+        assert 0.0 < r["ratio_oracle"] <= 1.0 + 1e-6
+        assert r["ratio_oracle"] <= r["ratio_is_loss"] + 1e-6
+        assert r["ratio_oracle"] <= r["ratio_is_grad_norm"] + 1e-6
+        assert r["var_uniform"] > 0.0
+
+    def test_ratio_convention_is_mean_of_variances(self, probe_result):
+        """ratio_* must be var_*/var_uniform of the POOL-MEAN variances
+        (the ADVICE r4 fix: one convention across exact and MC modes)."""
+        r = probe_result
+        np.testing.assert_allclose(
+            r["ratio_is_loss"], r["var_is_loss"] / r["var_uniform"],
+            rtol=1e-9)
+        np.testing.assert_allclose(
+            r["ratio_oracle"], r["var_oracle"] / r["var_uniform"],
+            rtol=1e-9)
+
+    def test_probe_forces_uniform_trajectory(self):
+        """An IS-configured config gives the SAME probe result as its
+        uniform twin: the probe compares estimators at common params and
+        must not let the config's own sampling flags skew the warm-up."""
+        base = dict(model="smallcnn", dataset="synthetic", world_size=1,
+                    batch_size=8, presample_batches=4,
+                    compute_dtype="float32", seed=0)
+        r_is = estimate_is_benefit(
+            TrainConfig(use_importance_sampling=True, **base),
+            warm_steps=2, pools=2)
+        r_uni = estimate_is_benefit(
+            TrainConfig(use_importance_sampling=False, **base),
+            warm_steps=2, pools=2)
+        np.testing.assert_allclose(r_is["var_uniform"],
+                                   r_uni["var_uniform"], rtol=1e-6)
+        np.testing.assert_allclose(r_is["ratio_is_loss"],
+                                   r_uni["ratio_is_loss"], rtol=1e-6)
+
+
+class TestRecommend:
+    def test_capped_regime(self):
+        msg = recommend({"ratio_oracle": 0.95, "ratio_is_loss": 0.9,
+                         "ratio_is_grad_norm": 0.9})
+        assert "uniform" in msg
+
+    def test_win_regime(self):
+        msg = recommend({"ratio_oracle": 0.1, "ratio_is_loss": 0.14,
+                         "ratio_is_grad_norm": 0.2})
+        assert "fresh scores" in msg
+
+    def test_grad_norm_regime(self):
+        msg = recommend({"ratio_oracle": 0.1, "ratio_is_loss": 0.9,
+                         "ratio_is_grad_norm": 0.3})
+        assert "grad_norm" in msg
+
+    def test_headroom_uncaptured(self):
+        msg = recommend({"ratio_oracle": 0.1, "ratio_is_loss": 0.9,
+                         "ratio_is_grad_norm": 0.9})
+        assert "stay uniform" in msg

@@ -354,6 +354,27 @@ class TestDigitsDatasets:
         # The TEST split stays balanced (identical to the base variant).
         np.testing.assert_array_equal(yte, yte_full)
 
+    def test_digits_seq_is_the_same_real_bytes(self):
+        """The FOUND sequence task (round-4 verdict #3): raw scanlines of
+        the same scans, same split — no windowing or amplitude shaping."""
+        (xtr, ytr), (xte, yte), info = load_dataset("digits_seq", seed=0)
+        (xtr_img, ytr_img), _, _ = load_dataset("digits", seed=0)
+        assert xtr.shape[1:] == (64, 1) and xtr.dtype == np.float32
+        assert xtr.min() >= 0.0 and xtr.max() <= 1.0
+        np.testing.assert_array_equal(ytr, ytr_img)  # identical split
+        assert not info["synthetic"]
+        # The sequence IS the scanline of the image variant's source scan:
+        # the 32×32 image upsamples each 8×8 pixel 4×4, so its [::4, ::4]
+        # subgrid flattened matches the sequence up to the uint8 quantize.
+        sub = xtr_img[0, ::4, ::4, 0].astype(np.float32) / 255.0
+        np.testing.assert_allclose(sub.reshape(64), xtr[0, :, 0], atol=0.01)
+
+    def test_digits_seq_imb_mirrors_image_protocol(self):
+        (_, ytr), (_, yte), _ = load_dataset("digits_seq_imb", seed=0)
+        (_, ytr_img), (_, yte_img), _ = load_dataset("digits_imb", seed=0)
+        np.testing.assert_array_equal(ytr, ytr_img)
+        np.testing.assert_array_equal(yte, yte_img)
+
 
 class TestSyntheticSeqHard:
     """The round-4 flagship-experiment task: 15% of samples carry the

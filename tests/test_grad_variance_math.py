@@ -34,7 +34,7 @@ def _enumerated_variance(g, probs, batch_size):
 
 class TestConditionalVariance:
     def _case(self, probs, batch_size=2, seed=0):
-        from grad_variance import conditional_variance
+        from mercury_tpu.analysis import conditional_variance
 
         rng = np.random.default_rng(seed)
         n = len(probs)
@@ -62,7 +62,7 @@ class TestConditionalVariance:
     def test_oracle_is_minimum(self):
         """p ∝ ‖gᵢ‖ minimizes the formula (Katharopoulos & Fleuret) —
         checked against uniform and random distributions."""
-        from grad_variance import conditional_variance
+        from mercury_tpu.analysis import conditional_variance
 
         rng = np.random.default_rng(2)
         g = rng.normal(size=(6, 4)) * rng.lognormal(0, 1.5, (6, 1))
