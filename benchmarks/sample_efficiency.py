@@ -187,6 +187,7 @@ def main(argv=None) -> int:
         ("is_pool4_k4", {"presample_batches": 4, "score_refresh_every": 4}),
         ("is_grad_norm_k4", {"importance_score": "grad_norm",
                              "score_refresh_every": 4}),
+        ("is_scoretable", {"sampler": "scoretable"}),
     ]
     if args.arms:
         wanted = args.arms.split(",")

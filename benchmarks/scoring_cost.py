@@ -1,0 +1,193 @@
+"""Scoring cost: pool sampler vs the scoretable sampler, on one device.
+
+The pool sampler pays a ``pool_size``-wide scoring forward every step
+(``presample_batches × batch_size`` candidates, reference semantics
+``pytorch_collab.py:95-106``). The scoretable sampler keeps a whole-shard
+score table device-resident and rescores only ``refresh_size`` slots per
+step (round-robin window; the trained batch's scores fall out of the
+training forward for free) — so its scoring FLOPs scale with
+``refresh_size``, not ``pool_size``, while the draw still sees every
+shard sample.
+
+This benchmark measures both sides of that trade on whatever backend it
+runs on (CPU included — the FLOP counts are analytic, and the wall-clock
+ordering holds anywhere the scoring forward dominates):
+
+- **scoring FLOPs/step** — XLA ``cost_analysis`` of the scoring forward
+  at each arm's candidate width (pool: ``pool_size``; scoretable:
+  ``refresh_size``), plus the analytic ratio;
+- **step wall-clock** — uniform, pool K=1 Mercury, cadence K=8, and the
+  scoretable arm, same protocol as ``is_cost_ladder.py``.
+
+Usage::
+
+    python benchmarks/scoring_cost.py [--steps 30] [--refresh-size 64]
+
+Appends one JSON record to ``benchmarks/results_scoring_cost.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
+
+import numpy as np  # noqa: E402
+
+
+def build(args, **overrides):
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        model=args.model,
+        dataset=args.dataset,
+        augmentation=("noniid" if args.dataset == "synthetic" else "none"),
+        world_size=1,
+        batch_size=args.batch_size,
+        presample_batches=args.presample_batches,
+        refresh_size=args.refresh_size,
+        steps_per_epoch=args.steps + 64,
+        num_epochs=1,
+        eval_every=0,
+        log_every=0,
+        seed=0,
+        **overrides,
+    )
+    return Trainer(config, mesh=make_mesh(1, config.mesh_axis))
+
+
+def scoring_flops(trainer, n: int):
+    """Analytic FLOPs of one scoring forward over ``n`` candidates —
+    XLA's cost model on the jitted inference apply (no execution)."""
+    import jax
+    import jax.numpy as jnp
+
+    model = trainer.model
+    state = trainer.state
+    sample_shape = tuple(int(s) for s in trainer.dataset.x_train.shape[1:])
+    imgs = jnp.zeros((n,) + sample_shape, jnp.float32)
+
+    def fwd(params, batch_stats, x):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+            logits, _ = model.apply(variables, x, train=True,
+                                    mutable=["batch_stats"])
+            return logits
+        return model.apply(variables, x, train=True)
+
+    compiled = (
+        jax.jit(fwd)
+        .lower(state.params, state.batch_stats, imgs)
+        .compile()
+    )
+    costs = compiled.cost_analysis()
+    if isinstance(costs, (list, tuple)):  # older jax returns [dict]
+        costs = costs[0]
+    return float(costs.get("flops", float("nan")))
+
+
+def measure(trainer, args) -> float:
+    """Steps/sec, host-fetch fenced (is_cost_ladder.py protocol)."""
+    ds = trainer.dataset
+    state = trainer.state
+    step_fn = trainer.train_step
+    for _ in range(3):
+        state, metrics = step_fn(state, ds.x_train, ds.y_train,
+                                 ds.shard_indices)
+        np.asarray(metrics["train/loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step_fn(state, ds.x_train, ds.y_train,
+                                 ds.shard_indices)
+    np.asarray(metrics["train/loss"])
+    dt = time.perf_counter() - t0
+    trainer.state = state
+    return args.steps / dt
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="smallcnn")
+    ap.add_argument("--dataset", default="synthetic")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--presample-batches", type=int, default=10)
+    ap.add_argument("--refresh-size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "results_scoring_cost.jsonl"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"# platform {dev.platform} / {dev.device_kind}", file=sys.stderr)
+
+    pool_size = args.presample_batches * args.batch_size
+    # local BN: the probe's forward runs outside shard_map, where sync
+    # BN's pmean axis is unbound (W=1 makes the two identical anyway).
+    probe = build(args, use_importance_sampling=False, batch_norm="local")
+    flops_pool = scoring_flops(probe, pool_size)
+    flops_table = scoring_flops(probe, args.refresh_size)
+    del probe
+    flops_ratio = (flops_pool / flops_table
+                   if flops_pool and flops_table else None)
+    print(f"# scoring FLOPs/step: pool({pool_size})={flops_pool:.3e} "
+          f"scoretable({args.refresh_size})={flops_table:.3e} "
+          f"ratio={flops_ratio:.2f}x", file=sys.stderr)
+
+    arms = [
+        ("uniform", {"use_importance_sampling": False}),
+        ("is_pool_k1", {}),
+        ("is_k8", {"score_refresh_every": 8}),
+        ("is_scoretable", {"sampler": "scoretable"}),
+    ]
+    results = {}
+    for label, overrides in arms:
+        try:
+            trainer = build(args, **overrides)
+            sps = measure(trainer, args)
+            del trainer
+        except Exception as e:  # one arm must not kill the run
+            print(f"# arm {label} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            sps = None
+        results[label] = round(sps, 2) if sps else None
+        print(f"# {label}: {results[label]} steps/s", file=sys.stderr)
+
+    uniform = results.get("uniform")
+    record = {
+        "schema": "scoring_cost_v1",
+        "model": args.model,
+        "dataset": args.dataset,
+        "batch_size": args.batch_size,
+        "pool_size": pool_size,
+        "refresh_size": args.refresh_size,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "scoring_flops_per_step": {
+            "pool": flops_pool,
+            "scoretable": flops_table,
+            "reduction": round(flops_ratio, 2) if flops_ratio else None,
+        },
+        "steps_per_sec": results,
+        "vs_uniform": {
+            label: (round(v / uniform, 3) if (v and uniform) else None)
+            for label, v in results.items()
+        },
+    }
+    with open(args.out, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,7 @@ import _bootstrap  # noqa: F401  (repo-root path + CPU-platform handling)
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from mercury_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mercury_tpu.models import TransformerClassifier

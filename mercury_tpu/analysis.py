@@ -87,7 +87,8 @@ def _snapshot_setup(trainer, batch_stats):
 
 
 def exact_variance_probe(trainer, params, batch_stats, key, n_pool,
-                         batch_size, n_pools, is_alpha):
+                         batch_size, n_pools, is_alpha,
+                         refresh_size=64, table_decay=0.98):
     """EXACT conditional (given-pool) estimator variances from per-sample
     gradients — no Monte-Carlo draws.
 
@@ -96,7 +97,11 @@ def exact_variance_probe(trainer, params, batch_stats, key, n_pool,
     conditional covariance trace is analytic (:func:`conditional_variance`),
     which lets us evaluate, on the same pools: uniform, the reference's
     loss-proportional score (``pytorch_collab.py:111-112``), the
-    grad-norm-bound score, AND the oracle ``p_i ∝ ‖g_i‖``. Also reports
+    grad-norm-bound score, a STALE score-table distribution (each score
+    aged ``decay^a`` toward the pool mean with a random age
+    ``a ∈ [0, ceil(L/refresh_size))`` — the steady-state staleness the
+    ``sampler="scoretable"`` round-robin refresh induces), AND the oracle
+    ``p_i ∝ ‖g_i‖``. Also reports
     the Pearson correlation of each score with the true per-sample grad
     norm (the proxy-quality diagnostic) and the coefficient of variation
     of ``‖g_i‖`` — the quantity that caps the oracle: as cv → 0 no
@@ -132,7 +137,12 @@ def exact_variance_probe(trainer, params, batch_stats, key, n_pool,
         return conditional_variance(probs, gnorm_sq, gbar_sq, n_pool,
                                     batch_size)
 
+    # Steady-state staleness bound of the scoretable's round-robin refresh:
+    # every shard slot is rescored within ceil(L/R) steps.
+    max_age = max(-(-shard_len // max(int(refresh_size), 1)), 1)
+
     def one_pool(key):
+        key, k_age = jax.random.split(key)
         slots = jax.random.choice(key, shard_len, (n_pool,), replace=False)
         px = normalize_images(x_shard[slots], mean, std)
         py = y_shard[slots]
@@ -148,6 +158,12 @@ def exact_variance_probe(trainer, params, batch_stats, key, n_pool,
         p_uni = jnp.full((n_pool,), 1.0 / n_pool)
         p_loss = importance_probs(losses, jnp.mean(losses), is_alpha)
         p_bound = importance_probs(bound, jnp.mean(bound), is_alpha)
+        # Scoretable: fresh losses aged toward the mean by decay^age —
+        # what the table actually samples from between refreshes.
+        ages = jax.random.randint(k_age, (n_pool,), 0, max_age)
+        mu = jnp.mean(losses)
+        stale = mu + (losses - mu) * table_decay ** ages.astype(jnp.float32)
+        p_table = importance_probs(stale, mu, is_alpha)
         # Floor like importance_probs: an exactly-zero gradient (saturated
         # softmax post-interpolation) would give 0/0 = NaN in var_of; its
         # true contribution is 0, which the floor preserves (gn² ≪ floor).
@@ -162,13 +178,14 @@ def exact_variance_probe(trainer, params, batch_stats, key, n_pool,
         return (var_of(p_uni, gn_sq, gbar_sq),
                 var_of(p_loss, gn_sq, gbar_sq),
                 var_of(p_bound, gn_sq, gbar_sq),
+                var_of(p_table, gn_sq, gbar_sq),
                 var_of(p_oracle, gn_sq, gbar_sq),
                 corr(losses, gn), corr(bound, gn),
                 gn.std() / (gn.mean() + 1e-12))
 
     keys = jax.random.split(key, n_pools)
     vals = jax.jit(jax.vmap(one_pool))(keys)
-    v_uni, v_loss, v_bound, v_orc, c_loss, c_bound, cv = (
+    v_uni, v_loss, v_bound, v_table, v_orc, c_loss, c_bound, cv = (
         np.asarray(v, np.float64) for v in vals
     )
     mu_uni = float(v_uni.mean())
@@ -176,13 +193,16 @@ def exact_variance_probe(trainer, params, batch_stats, key, n_pool,
         "var_uniform": mu_uni,
         "var_is_loss": float(v_loss.mean()),
         "var_is_grad_norm": float(v_bound.mean()),
+        "var_is_scoretable": float(v_table.mean()),
         "var_oracle": float(v_orc.mean()),
         "ratio_is_loss": float(v_loss.mean() / mu_uni),
         "ratio_is_grad_norm": float(v_bound.mean() / mu_uni),
+        "ratio_is_scoretable": float(v_table.mean() / mu_uni),
         "ratio_oracle": float(v_orc.mean() / mu_uni),
         "corr_loss_gradnorm": float(c_loss.mean()),
         "corr_bound_gradnorm": float(c_bound.mean()),
         "gradnorm_cv": float(cv.mean()),
+        "scoretable_max_age": int(max_age),
     }
 
 
@@ -243,6 +263,9 @@ def estimate_is_benefit(config, *, warm_steps: int = 100,
         zero_sharding=False,    # (task, model, pool, B) geometry, not of
         use_importance_sampling=False,  # how the full run will shard
         augmentation="none",
+        compute_dtype="float32",  # exact variances, not bf16-rounded ones:
+                                  # the probe compares estimators to ~2
+                                  # decimal places, inside bf16's noise
         batch_norm="local",     # W=1: sync's psum is unbound outside shard_map
         steps_per_epoch=max(warm_steps, 1),
         num_epochs=1,
@@ -260,7 +283,8 @@ def estimate_is_benefit(config, *, warm_steps: int = 100,
     out = exact_variance_probe(
         trainer, trainer.state.params, trainer.state.batch_stats, key,
         probe_cfg.candidate_pool_size, probe_cfg.batch_size, pools,
-        probe_cfg.is_alpha)
+        probe_cfg.is_alpha, refresh_size=probe_cfg.refresh_size,
+        table_decay=probe_cfg.table_decay)
     out["warm_steps"] = warm_steps
     out["pools"] = pools
     out["recommendation"] = recommend(out)

@@ -102,7 +102,16 @@ class TrainConfig:
     # "groupwise": persistent per-sample importance over the whole shard
     #   with sliding-window refresh + draws from the newest group
     #   (Groupwise_Sampler, util.py:94-160 — library-only in the reference,
-    #   a first-class strategy here).
+    #   a first-class strategy here);
+    # "scoretable": persistent [L] score table over the whole shard with
+    #   amortized incremental refresh (sampling/scoretable.py): each step
+    #   draws the train batch from the ENTIRE shard's distribution but
+    #   re-scores only refresh_size round-robin candidates (plus the
+    #   just-trained batch, whose scores fall out of the training forward
+    #   for free) — scoring FLOPs drop from candidate_pool_size to
+    #   refresh_size per step with no cadence staleness cliff: every
+    #   entry age-decays toward the EMA mean (table_decay) so stale
+    #   extremes fade and nothing starves.
     sampler: str = "pool"
     presample_batches: int = 10      # candidate pool = 10×batch (pytorch_collab.py:95)
     is_alpha: float = 0.5            # score = loss + alpha·EMA (pytorch_collab.py:111)
@@ -130,6 +139,24 @@ class TrainConfig:
     # win regime (heavy-tailed gradient norms, e.g. transformers past the
     # easy bulk) stale scores give the step advantage back — keep K=1.
     score_refresh_every: int = 1
+    # Scoretable sampler: how many shard slots the per-step round-robin
+    # refresh re-scores (the amortized scoring forward's batch). Full-shard
+    # staleness bound: every slot is re-scored at least once per
+    # ceil(L / refresh_size) steps. 64 ≈ 5× fewer scoring FLOPs than the
+    # reference's 320-candidate pool at the default geometry.
+    refresh_size: int = 64
+    # Scoretable sampler: per-step geometric decay of every table entry
+    # toward the EMA mean score (score ← μ + γ·(score − μ)). Entries
+    # refreshed a steps ago carry weight γ^a on their stale deviation —
+    # 0.98 halves a stale extreme in ~34 steps, about one full refresh
+    # cycle at L≈2200/refresh 64. 1.0 disables the decay (scores persist
+    # until re-scored, the groupwise behavior).
+    table_decay: float = 0.98
+    # Optional dtype override for the SCORING forward only (scores only
+    # rank, so bf16 scoring is safe even when training compute is f32) —
+    # e.g. "bfloat16" halves the refresh forward's bandwidth. None = score
+    # with compute_dtype (the training model).
+    scoring_dtype: Optional[str] = None
     # Pipelined scoring (pool sampler only): step t trains on the batch
     # selected at step t-1 and scores the NEXT pool with the same params —
     # the train fwd/bwd and the scoring forward become independent, so XLA

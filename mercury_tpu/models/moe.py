@@ -32,6 +32,8 @@ from typing import Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+from mercury_tpu.compat import axis_size
 from jax import lax
 
 
@@ -64,7 +66,7 @@ class MoEMLP(nn.Module):
             # Inside shard_map each device holds its expert shard, so the
             # declared param shapes are per-device. Initialize params with
             # a dense twin (ep_axis=None) and shard their leading axis.
-            w = lax.axis_size(self.ep_axis)
+            w = axis_size(self.ep_axis)
             if e % w:
                 raise ValueError(
                     f"num_experts {e} not divisible by axis size {w}"
@@ -140,7 +142,7 @@ class MoEMLP(nn.Module):
             return y.reshape(orig_shape).astype(x.dtype), aux
 
         # ---------------- expert-parallel dispatch ----------------
-        w = lax.axis_size(self.ep_axis)
+        w = axis_size(self.ep_axis)
         e_loc = e // w
         # Exchange expert-major slabs: [W, E_loc, C, D] — after all_to_all
         # the leading axis indexes the SOURCE device and E_loc are my

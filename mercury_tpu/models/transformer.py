@@ -26,6 +26,8 @@ from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from mercury_tpu.compat import axis_size
 from jax import lax
 
 from mercury_tpu.parallel.sequence import attention
@@ -210,7 +212,7 @@ class TransformerClassifier(nn.Module):
                     f"{self.max_len}"
                 )
             return x + pe[None, :t]
-        global_len = t * lax.axis_size(self.sp_axis)
+        global_len = t * axis_size(self.sp_axis)
         if global_len > self.max_len:
             raise ValueError(
                 f"sequence length {global_len} exceeds max_len={self.max_len}"
@@ -229,7 +231,7 @@ class TransformerClassifier(nn.Module):
                 raise ValueError(
                     f"zigzag layout needs an even local length, got {t}"
                 )
-            w = lax.axis_size(self.sp_axis)
+            w = axis_size(self.sp_axis)
             i = lax.axis_index(self.sp_axis)
             c = t // 2
             pos = jnp.concatenate([

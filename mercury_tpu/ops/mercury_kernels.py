@@ -149,17 +149,11 @@ def _cdf_chunk(n: int) -> int:
     return t
 
 
-def _score_draw_kernel(
-    losses_ref, ema_ref, uniforms_ref,
-    probs_ref, selected_ref, scaled_ref,
-    *, alpha: float, true_n: int,
-):
-    """score → normalize → chunked inverse-CDF draw → p·N gather, all in
-    VMEM.
+def _inverse_cdf_draw(probs, u, true_n: int):
+    """Chunked inverse-CDF categorical draw, in-kernel shared math.
 
-    ``losses_ref``: [N, 1]; ``ema_ref``: [1, 1] (SMEM); ``uniforms_ref``:
-    [1, B] iid U(0,1). Outputs: normalized probs [N, 1], selected pool
-    positions [1, B] int32, scaled probs p·N [1, B].
+    ``probs``: [N, 1] normalized; ``u``: [1, B] iid U(0,1). Returns the
+    drawn indices [1, B] int32, clamped to the REAL pool (< ``true_n``).
 
     Mosaic notes: ``cumsum`` has no TC lowering, so each chunk's local CDF
     is a lower-triangular matmul (MXU) over a ``[T, T]`` tile, offset by
@@ -169,14 +163,7 @@ def _score_draw_kernel(
     footprint — O(T²) instead of O(N²) — and nothing else. The loop over
     N/T chunks is a static Python unroll (straight-line Mosaic program).
     """
-    losses = losses_ref[:]                                # [N, 1]
-    n = losses.shape[0]
-    scores = jnp.maximum(losses + alpha * ema_ref[0, 0], 1e-12)  # :111
-    total = jnp.sum(scores)
-    probs = scores / total                                # :112
-    probs_ref[:] = probs
-
-    u = uniforms_ref[:]                                   # [1, B]
+    n = probs.shape[0]
     b = u.shape[1]
     t = _cdf_chunk(n)
     row = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
@@ -200,19 +187,44 @@ def _score_draw_kernel(
     # Clamp to the REAL pool: padded rows (wrapper-added, score 1e-12)
     # carry ~zero probability, and the clamp guarantees a draw can never
     # land on one even at u → 1.
-    idx = jnp.minimum(counts, true_n - 1)                 # [1, B]
-    selected_ref[:] = idx
+    return jnp.minimum(counts, true_n - 1)                # [1, B]
 
-    # scaled_b = p[idx_b]·N via one-hot mask-and-reduce (gather-free;
-    # [N, B] is O(N·B) — pool·batch, not pool², so it stays unchunked).
-    # N is the REAL pool size: the p·N reweight contract (:116) is about
-    # the candidate count the caller drew from, not the padded tile.
+
+def _scaled_probs_gather(probs, idx, true_n: int):
+    """``scaled_b = p[idx_b]·N`` via one-hot mask-and-reduce (gather-free;
+    [N, B] is O(N·B) — pool·batch, not pool², so it stays unchunked).
+    N is the REAL pool size: the p·N reweight contract (:116) is about
+    the candidate count the caller drew from, not the padded tile."""
+    n = probs.shape[0]
+    b = idx.shape[1]
     onehot = (
         jax.lax.broadcasted_iota(jnp.int32, (n, b), 0) == idx
     ).astype(jnp.float32)                                 # [N, B]
-    scaled_ref[:] = jnp.sum(
-        onehot * (probs * true_n), axis=0, keepdims=True
-    )  # p·N (:116)
+    return jnp.sum(onehot * (probs * true_n), axis=0, keepdims=True)
+
+
+def _score_draw_kernel(
+    losses_ref, ema_ref, uniforms_ref,
+    probs_ref, selected_ref, scaled_ref,
+    *, alpha: float, true_n: int,
+):
+    """score → normalize → chunked inverse-CDF draw → p·N gather, all in
+    VMEM.
+
+    ``losses_ref``: [N, 1]; ``ema_ref``: [1, 1] (SMEM); ``uniforms_ref``:
+    [1, B] iid U(0,1). Outputs: normalized probs [N, 1], selected pool
+    positions [1, B] int32, scaled probs p·N [1, B].
+    """
+    losses = losses_ref[:]                                # [N, 1]
+    scores = jnp.maximum(losses + alpha * ema_ref[0, 0], 1e-12)  # :111
+    total = jnp.sum(scores)
+    probs = scores / total                                # :112
+    probs_ref[:] = probs
+
+    u = uniforms_ref[:]                                   # [1, B]
+    idx = _inverse_cdf_draw(probs, u, true_n)
+    selected_ref[:] = idx
+    scaled_ref[:] = _scaled_probs_gather(probs, idx, true_n)  # p·N (:116)
 
 
 def score_and_draw_pallas(
@@ -267,3 +279,124 @@ def score_and_draw_pallas(
         uniforms,
     )
     return probs[:n, 0], selected[0, :], scaled[0, :]
+
+
+# ----------------------------------------------------------------- kernel 3
+def _table_refresh_draw_kernel(
+    table_ref, slots_ref, rscores_ref, ema_ref, uniforms_ref,
+    table_out_ref, probs_ref, selected_ref, scaled_ref,
+    *, alpha: float, decay: float, true_n: int,
+):
+    """Fused score-table step (``sampler="scoretable"``): age-decay the
+    whole table toward the EMA mean, scatter the freshly scored refresh
+    window in, smooth/normalize over ALL slots, and draw the train batch —
+    one VMEM pass over the persistent ``[L]`` table, no HBM round trip
+    between the decay, the scatter, and the CDF.
+
+    ``table_ref``: [N, 1] persistent scores; ``slots_ref``/``rscores_ref``:
+    [1, R] refresh window (slot ids < true_n, fresh scores);
+    ``ema_ref``: [1, 1] (SMEM); ``uniforms_ref``: [1, B]. Outputs: the
+    refreshed table [N, 1], normalized probs [N, 1], selected slots
+    [1, B] int32, scaled probs p·L [1, B].
+
+    The scatter is a one-hot mask-and-reduce over [N, R] (R ≪ N — the
+    whole point of the refresh window), with duplicate slots averaged —
+    exactly ``sampling.scoretable.scatter_mean``. Padded rows (wrapper-
+    added past ``true_n``) are re-floored to -1e30 every call so the decay
+    can never resurrect them into the distribution.
+    """
+    mu = ema_ref[0, 0]
+    table = table_ref[:]                                  # [N, 1]
+    n = table.shape[0]
+    # Staleness decay: entries refreshed a steps ago sit γ^a of the way
+    # back to the EMA mean — stale extremes fade, nothing starves.
+    decayed = mu + (table - mu) * decay
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+    decayed = jnp.where(rows < true_n, decayed, -1e30)
+
+    slots = slots_ref[:]                                  # [1, R]
+    rscores = rscores_ref[:]                              # [1, R]
+    hit = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, slots.shape[1]), 0) == slots
+    ).astype(jnp.float32)                                 # [N, R]
+    sums = jnp.sum(hit * rscores, axis=1, keepdims=True)  # [N, 1]
+    counts = jnp.sum(hit, axis=1, keepdims=True)          # [N, 1]
+    refreshed = jnp.where(
+        counts > 0, sums / jnp.maximum(counts, 1.0), decayed
+    )
+    table_out_ref[:] = refreshed
+
+    scores = jnp.maximum(refreshed + alpha * mu, 1e-12)
+    probs = scores / jnp.sum(scores)
+    probs_ref[:] = probs
+
+    idx = _inverse_cdf_draw(probs, uniforms_ref[:], true_n)
+    selected_ref[:] = idx
+    scaled_ref[:] = _scaled_probs_gather(probs, idx, true_n)  # p·L
+
+
+def table_refresh_draw_pallas(
+    key: jax.Array,
+    scores: jax.Array,
+    refresh_slots: jax.Array,
+    refresh_scores: jax.Array,
+    ema_value: jax.Array,
+    batch_size: int,
+    alpha: float = 0.5,
+    decay: float = 0.98,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused scoretable decay + scatter-refresh + full-table draw.
+
+    Returns ``(new_scores [L], probs [L], selected [B] int32,
+    scaled_probs [B])``, matching the jax-native
+    ``sampling.scoretable.table_refresh_draw`` (same decay/scatter/probs
+    bit math; draws use the same inverse-CDF machinery as
+    :func:`score_and_draw_pallas`, reproducible from the JAX key).
+    """
+    n = scores.shape[0]
+    n_pad = n
+    scores = scores.astype(jnp.float32)
+    if _pow2_divisor(n) < 64 and n > 1024:
+        # Same awkward-size rule as score_and_draw_pallas: pad to a
+        # 512-multiple; pad rows carry -1e30 (score floor, never drawn)
+        # and are re-floored in-kernel each call, then sliced off here —
+        # the persistent table the caller carries stays [L].
+        n_pad = -(-n // 512) * 512
+        scores = jnp.concatenate([
+            scores, jnp.full((n_pad - n,), -1e30, jnp.float32)
+        ])
+    uniforms = jax.random.uniform(key, (1, batch_size), jnp.float32)
+    kernel = functools.partial(
+        _table_refresh_draw_kernel, alpha=alpha, decay=decay, true_n=n
+    )
+    r = refresh_slots.shape[0]
+    new_table, probs, selected, scaled = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
+            jax.ShapeDtypeStruct((1, batch_size), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=_interpret(),
+    )(
+        scores.reshape(-1, 1),
+        refresh_slots.reshape(1, r).astype(jnp.int32),
+        refresh_scores.reshape(1, r).astype(jnp.float32),
+        ema_value.reshape(1, 1).astype(jnp.float32),
+        uniforms,
+    )
+    return new_table[:n, 0], probs[:n, 0], selected[0, :], scaled[0, :]

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from mercury_tpu.compat import shard_map
 
 
 def allreduce_mean_tree(tree: Any, axis_name: str) -> Any:

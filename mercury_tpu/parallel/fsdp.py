@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from mercury_tpu.compat import donate_argnums
+
 
 def fsdp_shardings(params, mesh: Mesh, axis: str = "data",
                    min_size: int = 1024):
@@ -120,7 +122,7 @@ def make_fsdp_train_step(
                 step,
                 out_shardings=(shardings_of(params), shardings_of(opt_state),
                                replicated),
-                donate_argnums=(0, 1),
+                donate_argnums=donate_argnums(0, 1),
             )
         x = jax.device_put(x, batch_sharding)
         y = jax.device_put(y, batch_sharding)

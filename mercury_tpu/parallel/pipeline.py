@@ -52,7 +52,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+from mercury_tpu.compat import axis_size, pcast, shard_map
 
 
 def stack_block_params(params: dict, num_layers: int) -> Tuple[dict, dict]:
@@ -161,7 +161,7 @@ def make_pp_apply(
         return model.apply({"params": rest}, h, method="head")
 
     def local_apply(stacked_local, rest, x):
-        s = lax.axis_size(axis)
+        s = axis_size(axis)
         idx = lax.axis_index(axis)
         # Token count comes from the EMBEDDED sequence — raw x may be a
         # 4-D image batch that embed patchifies (ViT mode).
@@ -193,7 +193,7 @@ def make_pp_apply(
 
             # The aux carry must match the block output's device-varying
             # type over the manual axes.
-            aux_init = lax.pcast(jnp.zeros(()), varying_axes, to="varying")
+            aux_init = pcast(jnp.zeros(()), varying_axes, to="varying")
             (out, aux), _ = lax.scan(body, (h, aux_init), stacked_local)
             return out, aux
 
@@ -201,15 +201,15 @@ def make_pp_apply(
             apply_stage = jax.checkpoint(apply_stage)
 
         perm = [(i, (i + 1) % s) for i in range(s)]
-        zeros = lax.pcast(
+        zeros = pcast(
             jnp.zeros((mb, t_len, model.d_model), h_mb.dtype), varying_axes,
             to="varying",
         )
-        buf0 = lax.pcast(
+        buf0 = pcast(
             jnp.zeros((m, mb, t_len, model.d_model), h_mb.dtype),
             varying_axes, to="varying",
         )
-        aux0 = lax.pcast(jnp.zeros(()), varying_axes, to="varying")
+        aux0 = pcast(jnp.zeros(()), varying_axes, to="varying")
 
         def tick(carry, t):
             prev_out, buf, aux = carry

@@ -33,6 +33,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from mercury_tpu.compat import axis_size
 from jax import lax
 
 NEG_INF = -1e30
@@ -110,7 +112,7 @@ def ring_attention(
     :func:`zigzag_ring_attention` for causal sequences: its balanced block
     assignment does half the matmul FLOPs per hop.
     """
-    w = lax.axis_size(axis_name)
+    w = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, l_loc, h, d = q.shape
 
@@ -217,7 +219,7 @@ def zigzag_ring_attention(
     ``causal=False`` falls back to the plain ring fold (layout does not
     affect non-causal attention results per position).
     """
-    w = lax.axis_size(axis_name)
+    w = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, l_loc, h, d = q.shape
     if l_loc % 2 != 0:
@@ -335,7 +337,7 @@ def ulysses_attention(
     all-to-all the local sequence axis IS the global one, so the standard
     causal mask applies unchanged.
     """
-    w = lax.axis_size(axis_name)
+    w = axis_size(axis_name)
     h = q.shape[2]
     if h % w != 0:
         raise ValueError(

@@ -5,6 +5,16 @@ from mercury_tpu.sampling.groupwise import (  # noqa: F401
     update_importance,
     window_indices,
 )
+from mercury_tpu.sampling.scoretable import (  # noqa: F401
+    ScoreTableState,
+    advance_cursor,
+    decay_scores,
+    init_score_table,
+    refresh_window,
+    scatter_mean,
+    table_probs,
+    table_refresh_draw,
+)
 from mercury_tpu.sampling.importance import (  # noqa: F401
     EMAState,
     SelectionResult,

@@ -354,8 +354,17 @@ def _restore_one(directory: str, template: Any, step: int) -> Any:
 
         with open(path + ".msgpack", "rb") as f:
             restored = flax.serialization.from_bytes(template_data, f.read())
-    # Return host-resident (uncommitted) arrays so the next jitted step is
-    # free to place them per its shardings — orbax otherwise commits
-    # everything to device 0, which conflicts with a multi-device mesh.
+    # Pull everything to host first — orbax otherwise hands back arrays
+    # committed to device 0 with layouts of ITS choosing, which conflicts
+    # with a multi-device mesh.
     restored = jax.device_get(restored)
-    return _rewrap_keys(template, restored)
+    restored = _rewrap_keys(template, restored)
+    # Do NOT return the raw host numpy: on CPU the next device_put may
+    # zero-copy alias these buffers (some are tensorstore/mmap-backed),
+    # and the first donated train step then releases memory XLA does not
+    # own — observed as NaN params, SIGSEGV, or glibc heap corruption
+    # when the step executable is replayed from the persistent
+    # compilation cache. A trivial jitted identity materializes every
+    # leaf as an executable OUTPUT, i.e. an XLA-allocated buffer that is
+    # safe to donate; later jits remain free to re-place it.
+    return jax.jit(lambda t: t)(restored)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
+from mercury_tpu.compat import donate_argnums
 from mercury_tpu.config import TrainConfig
 from mercury_tpu.data.pipeline import ShardStream, init_shard_streams, next_pool
 from mercury_tpu.parallel.pipeline import make_pp_apply
@@ -100,8 +101,9 @@ def make_pp_mercury_step(
     fused data-parallel step applies (``train/step.py``). The default IS
     ``TrainConfig.moe_aux_weight`` (one source of truth); a caller using a
     config with a non-default value must pass ``config.moe_aux_weight``
-    explicitly — this factory takes keywords, not a ``TrainConfig``. The scoring pass discards the aux (scores
-    are per-sample CE, matching ``pytorch_collab.py:102``).
+    explicitly — this factory takes keywords, not a ``TrainConfig``. The
+    scoring pass discards the aux (scores are per-sample CE, matching
+    ``pytorch_collab.py:102``).
     """
     pool_size = presample_batches * batch_size
     if pool_size % num_microbatches or batch_size % num_microbatches:
@@ -164,4 +166,4 @@ def make_pp_mercury_step(
             "train/moe_aux": moe_aux,
         }
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jax.jit(step, donate_argnums=donate_argnums(0))

@@ -30,7 +30,7 @@ from typing import Callable, Dict
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from mercury_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from mercury_tpu.sampling.importance import per_sample_loss, reweighted_loss

@@ -20,6 +20,7 @@ import optax
 from mercury_tpu.data.pipeline import ShardStream
 from mercury_tpu.sampling.groupwise import GroupwiseState, init_groupwise
 from mercury_tpu.sampling.importance import EMAState, init_ema
+from mercury_tpu.sampling.scoretable import init_score_table
 
 
 class CachedPool(NamedTuple):
@@ -63,6 +64,7 @@ class MercuryState:
     groupwise: Any = None           # [W]-stacked GroupwiseState (sampler="groupwise")
     pending: Any = None             # [W]-stacked PendingBatch (pipelined_scoring)
     cached_pool: Any = None         # [W]-stacked CachedPool (score_refresh_every>1)
+    scoretable: Any = None          # [W]-stacked ScoreTableState (sampler="scoretable")
 
 
 def init_worker_sampler_state(
@@ -99,6 +101,7 @@ def create_state(
     zero_sharding: bool = False,
     init_opt: bool = True,
     cached_pool_size: int = 0,
+    with_scoretable: bool = False,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -168,6 +171,15 @@ def create_state(
                            1.0 / cached_pool_size, jnp.float32),
             pool_loss=jnp.zeros((n_workers,), jnp.float32),
         )
+    scoretable = None
+    if with_scoretable:
+        # Uniform initial scores over every shard slot — step 0 draws
+        # uniformly (the table IS the distribution, no priming branch
+        # needed) and the first refresh windows sharpen it in place.
+        t0 = init_score_table(shard_len)
+        scoretable = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (n_workers,) + x.shape), t0
+        )
     return MercuryState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -179,6 +191,7 @@ def create_state(
         groupwise=groupwise,
         pending=pending,
         cached_pool=cached_pool,
+        scoretable=scoretable,
     )
 
 

@@ -43,14 +43,23 @@ from mercury_tpu.sampling.importance import (
     reweighted_loss,
     select_from_pool,
 )
+from mercury_tpu.sampling.scoretable import (
+    ScoreTableState,
+    advance_cursor,
+    refresh_window,
+    scatter_mean,
+    table_refresh_draw,
+)
 from mercury_tpu.train.state import CachedPool, MercuryState, PendingBatch
 
-from jax import shard_map
+from mercury_tpu.compat import (MODERN_JAX, axis_size, donate_argnums,
+                                shard_map)
 
 
 def _state_specs(
     axis: str, has_groupwise: bool = False, has_pending: bool = False,
     zero_sharding: bool = False, has_cached_pool: bool = False,
+    has_scoretable: bool = False,
 ) -> MercuryState:
     """PartitionSpec pytree-prefix for :class:`MercuryState`: model state
     replicated, per-worker sampler state sharded along the data axis;
@@ -67,13 +76,14 @@ def _state_specs(
         groupwise=P(axis) if has_groupwise else None,
         pending=P(axis) if has_pending else None,
         cached_pool=P(axis) if has_cached_pool else None,
+        scoretable=P(axis) if has_scoretable else None,
     )
 
 
 def mercury_state_out_shardings(
     mesh: Mesh, axis: str, params_sh, opt_sh,
     has_groupwise: bool = False, has_pending: bool = False,
-    has_cached_pool: bool = False,
+    has_cached_pool: bool = False, has_scoretable: bool = False,
 ) -> Tuple[MercuryState, Any]:
     """Output shardings pinning the post-step state layout under partial-
     auto meshes (dp×tp): without this, GSPMD is free to re-replicate the
@@ -92,10 +102,16 @@ def mercury_state_out_shardings(
         opt_state=opt_sh,
         ema=EMAState(value=n(P(axis)), count=n(P(axis))),
         stream=ShardStream(perm=n(P(axis)), cursor=n(P(axis))),
-        rng=n(P(axis)),
+        # Legacy jax rejects a tiled out_sharding on a PRNG key array
+        # under a partial-manual mesh (the hidden [..., 2] payload dim is
+        # missing from the tile assignment at validation). Replicating the
+        # tiny [W]-key leaf sidesteps the bug; shard_map re-slices it per
+        # worker on the next step's entry either way.
+        rng=n(P(axis)) if MODERN_JAX else n(P()),
         groupwise=n(P(axis)) if has_groupwise else None,
         pending=n(P(axis)) if has_pending else None,
         cached_pool=n(P(axis)) if has_cached_pool else None,
+        scoretable=n(P(axis)) if has_scoretable else None,
     )
     return state_sh, n(P())
 
@@ -109,6 +125,7 @@ def make_train_step(
     std: np.ndarray,
     scan_steps: int = 1,
     state_out_shardings=None,
+    scoring_model=None,
 ) -> Callable[..., Tuple[MercuryState, Dict[str, jax.Array]]]:
     """Build the jitted train step.
 
@@ -121,6 +138,12 @@ def make_train_step(
     steps per call — the step body wrapped in ``lax.scan`` inside the same
     ``shard_map`` program, so one host dispatch covers the whole chunk and
     each metric comes back as a ``[scan_steps]`` array.
+
+    ``scoring_model`` (optional) is a second module with identical params
+    structure but a different compute dtype (``config.scoring_dtype``);
+    when given, the candidate-scoring forward runs through it instead of
+    ``model`` — the IS reweight divides by the realized probabilities, so
+    a lower-precision scorer reranks candidates without biasing the loss.
     """
     axis = config.mesh_axis
     use_is = config.use_importance_sampling
@@ -162,7 +185,7 @@ def make_train_step(
         use_pallas = on_tpu()
     if use_pallas and config.label_smoothing != 0.0:
         raise ValueError("use_pallas requires label_smoothing == 0")
-    if config.sampler not in ("pool", "groupwise"):
+    if config.sampler not in ("pool", "groupwise", "scoretable"):
         raise ValueError(f"unknown sampler {config.sampler!r}")
     if config.grad_compression not in ("none", "stochastic", "int8"):
         raise ValueError(f"unknown grad_compression {config.grad_compression!r}")
@@ -178,31 +201,51 @@ def make_train_step(
             "(Trainer does) or drop grad_compression"
         )
     use_groupwise = use_is and config.sampler == "groupwise"
+    use_scoretable = use_is and config.sampler == "scoretable"
     pipelined = use_is and config.pipelined_scoring
     zero = config.zero_sharding
-    if pipelined and use_groupwise:
+    if pipelined and config.sampler != "pool":
         # Measured justification for this cut (round-3 ladder,
         # BASELINE.md): pipelined overlap recovered ~2% on chip even for
         # the pool sampler — the scoring cost is FLOPs, not exposed
-        # latency — so a groupwise pipeline's ceiling is the same ~2%.
-        # Cadence (score_refresh_every) is the lever that actually pays.
-        raise ValueError("pipelined_scoring requires sampler='pool'")
+        # latency — so a groupwise/scoretable pipeline's ceiling is the
+        # same ~2%, and those samplers already shrink the scoring cost.
+        raise ValueError(
+            "pipelined_scoring requires sampler='pool', got "
+            f"{config.sampler!r}"
+        )
     cadence = int(config.score_refresh_every)
     if cadence < 1:
         raise ValueError(
             f"score_refresh_every must be >= 1, got {cadence}"
         )
     use_cadence = use_is and cadence > 1
-    if use_cadence and use_groupwise:
+    if use_cadence and config.sampler != "pool":
         raise ValueError(
             "score_refresh_every > 1 requires sampler='pool' (the "
-            "groupwise sampler already persists scores across steps)"
+            f"{config.sampler!r} sampler already persists scores across "
+            "steps)"
         )
     if use_cadence and pipelined:
         raise ValueError(
             "score_refresh_every > 1 does not compose with "
             "pipelined_scoring: cadence already removes the per-step "
             "scoring forward the pipeline overlaps"
+        )
+    refresh_size = int(config.refresh_size)
+    if use_scoretable:
+        if refresh_size < 1:
+            raise ValueError(
+                f"refresh_size must be >= 1, got {refresh_size}"
+            )
+        if not 0.0 <= config.table_decay <= 1.0:
+            raise ValueError(
+                f"table_decay must be in [0, 1], got {config.table_decay}"
+            )
+    if config.scoring_dtype is not None and not use_is:
+        raise ValueError(
+            "scoring_dtype only affects the candidate-scoring forward; "
+            "set use_importance_sampling=True (or drop scoring_dtype)"
         )
 
     if config.importance_score not in ("loss", "grad_norm"):
@@ -334,9 +377,23 @@ def make_train_step(
             to scoring cannot drift between them)."""
             raw, labs = gather_train(slots)
             imgs = _augment(ka, normalize_images(raw, mean, std))
-            pool_logits, _, _ = _apply_train(
-                state.params, state.batch_stats, imgs, False
-            )
+            if scoring_model is None:
+                pool_logits, _, _ = _apply_train(
+                    state.params, state.batch_stats, imgs, False
+                )
+            else:
+                # Same params, lower-precision compute (scoring_dtype) —
+                # scores only rank candidates, and the reweight divides by
+                # the realized probs, so this stays unbiased.
+                variables = {"params": state.params}
+                mutable = ["losses"]
+                if state.batch_stats:
+                    variables["batch_stats"] = state.batch_stats
+                    mutable = ["batch_stats", "losses"]
+                pool_logits, _ = scoring_model.apply(
+                    variables, imgs, train=True, mutable=mutable
+                )
+                pool_logits = pool_logits.astype(jnp.float32)
             return imgs, labs, pool_logits, _score_per_sample(
                 pool_logits, labs
             )
@@ -422,6 +479,48 @@ def make_train_step(
             sel_images = _augment(k_aug2, normalize_images(sel_raw, mean, std))
             avg_pool_loss = cached.pool_loss
             new_cached = cached
+        elif use_scoretable:
+            # --- score-table sampler: a device-resident [L] float32 score
+            # over THIS worker's whole shard. Each step (a) refreshes only
+            # `refresh_size` entries — a round-robin window, so every slot
+            # is rescored within ceil(L/R) steps — via one small scoring
+            # forward, (b) age-decays the rest toward the EMA mean
+            # (staleness-aware smoothing: an entry untouched for k steps
+            # has shrunk by decay^k toward the pool-typical score), and
+            # (c) draws the train batch from the FULL shard's distribution
+            # in one fused normalize→CDF→draw kernel. Scoring FLOPs per
+            # step drop from pool_size to refresh_size while the draw sees
+            # every sample — vs. the pool sampler's fresh-320 window.
+            table = jax.tree_util.tree_map(lambda x: x[0], state.scoretable)
+            refresh_slots = refresh_window(table, refresh_size)
+            _, r_labels, r_logits, r_scores = score_slots(
+                refresh_slots, k_aug
+            )
+            score_avg = pool_mean(r_scores, stat_axis)
+            ema = ema_update(ema, score_avg, config.ema_alpha)
+            if use_pallas:
+                from mercury_tpu.ops import table_refresh_draw_pallas
+
+                new_scores, _, selected, scaled_probs = (
+                    table_refresh_draw_pallas(
+                        k_sel, table.scores, refresh_slots, r_scores,
+                        ema.value, batch_size,
+                        alpha=config.is_alpha, decay=config.table_decay,
+                    )
+                )
+            else:
+                new_scores, _, selected, scaled_probs = table_refresh_draw(
+                    k_sel, table.scores, refresh_slots, r_scores,
+                    ema.value, batch_size,
+                    alpha=config.is_alpha, decay=config.table_decay,
+                )
+            sel_raw, sel_labels = gather_train(selected)
+            sel_images = _augment(
+                k_aug2, normalize_images(sel_raw, mean, std)
+            )
+            avg_pool_loss = _pool_loss_metric(r_logits, r_labels, score_avg)
+            table_scores_predraw = new_scores
+            table_selected = selected
         else:
             if use_groupwise:
                 # Sliding-window refresh over the shard (util.py:114-138):
@@ -503,6 +602,24 @@ def make_train_step(
             loss_fn, has_aux=True
         )(state.params)
 
+        new_scoretable = state.scoretable
+        if use_scoretable:
+            # Free write-back: the train forward's logits re-score the
+            # just-trained slots for zero extra FLOPs (they fall out of the
+            # backward pass anyway); with-replacement duplicates average.
+            train_scores = _score_per_sample(
+                logits.astype(jnp.float32), sel_labels
+            )
+            new_table = ScoreTableState(
+                scores=scatter_mean(
+                    table_scores_predraw, table_selected, train_scores
+                ),
+                cursor=advance_cursor(table, refresh_size),
+            )
+            new_scoretable = jax.tree_util.tree_map(
+                lambda x: x[None], new_table
+            )
+
         # --- optional quantization: each worker stochastically quantizes
         # its local gradient (independent keys); the mean across workers
         # stays unbiased — the live version of the reference's dead-code
@@ -541,7 +658,7 @@ def make_train_step(
                 tree_flatten_to_vector,
             )
 
-            w = lax.axis_size(axis)
+            w = axis_size(axis)
             opt_chunk = jax.tree_util.tree_map(lambda x: x[0], state.opt_state)
             gvec, unravel = tree_flatten_to_vector(grads)
             if int8_allreduce:
@@ -585,7 +702,7 @@ def make_train_step(
                     )
 
                     grads = compressed_pmean_tree_sharded(
-                        grads, axis, lax.axis_size(axis),
+                        grads, axis, axis_size(axis),
                         jax.random.fold_in(rng, 0x72),
                         specs=sharded_param_specs,
                     )
@@ -595,7 +712,7 @@ def make_train_step(
                     )
 
                     grads = compressed_allreduce_mean_tree(
-                        grads, axis, lax.axis_size(axis),
+                        grads, axis, axis_size(axis),
                         jax.random.fold_in(rng, 0x72),
                     )
             else:
@@ -631,6 +748,7 @@ def make_train_step(
                 jax.tree_util.tree_map(lambda x: x[None], new_cached)
                 if use_cadence else state.cached_pool
             ),
+            scoretable=new_scoretable,
         )
         metrics = {
             "train/loss": loss_mean,
@@ -654,11 +772,28 @@ def make_train_step(
 
     specs = _state_specs(axis, has_groupwise=use_groupwise,
                          has_pending=pipelined, zero_sharding=zero,
-                         has_cached_pool=use_cadence)
+                         has_cached_pool=use_cadence,
+                         has_scoretable=use_scoretable)
     smap_kw = {}
     if auto_axes:
         # Manual over the data axis only; GSPMD handles the rest.
         smap_kw["axis_names"] = frozenset({axis})
+    raw_rng = bool(auto_axes) and not MODERN_JAX
+    if raw_rng:
+        # Legacy partial-manual lowering rejects PRNG key leaves in the
+        # body's out_specs (the hidden [..., 2] payload dim is missing
+        # from the tile assignment — see compat.MODERN_JAX). Carry the
+        # rng across the shard_map boundary as raw uint32 and rewrap it
+        # just inside/outside; P(axis) prefixes the extra dim fine.
+        inner_fn = fn
+
+        def fn(state, x_train, y_train, shard_indices):
+            state = state.replace(rng=jax.random.wrap_key_data(state.rng))
+            new_state, metrics = inner_fn(
+                state, x_train, y_train, shard_indices)
+            return new_state.replace(
+                rng=jax.random.key_data(new_state.rng)), metrics
+
     data_spec = P(axis) if data_sharded else P()
     sharded = shard_map(
         fn,
@@ -668,10 +803,20 @@ def make_train_step(
         check_vma=False,
         **smap_kw,
     )
+    if raw_rng:
+        inner_sharded = sharded
+
+        def sharded(state, x_train, y_train, shard_indices):
+            state = state.replace(rng=jax.random.key_data(state.rng))
+            new_state, metrics = inner_sharded(
+                state, x_train, y_train, shard_indices)
+            return new_state.replace(
+                rng=jax.random.wrap_key_data(new_state.rng)), metrics
+
     jit_kw = {}
     if state_out_shardings is not None:
         jit_kw["out_shardings"] = state_out_shardings
-    return jax.jit(sharded, donate_argnums=(0,), **jit_kw)
+    return jax.jit(sharded, donate_argnums=donate_argnums(0), **jit_kw)
 
 
 def make_eval_step(model) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array]]:

@@ -168,6 +168,19 @@ class Trainer:
             bn_axis_name=bn_axis,
             **model_kw,
         )
+        # Optional low-precision scorer: same architecture (params are
+        # shared — flax modules are layout, not weights), different compute
+        # dtype for the candidate-scoring forward only.
+        self.scoring_model = None
+        if config.scoring_dtype is not None:
+            self.scoring_model = create_model(
+                config.model,
+                num_classes=self.dataset.num_classes,
+                compute_dtype=config.scoring_dtype,
+                param_dtype=config.param_dtype,
+                bn_axis_name=bn_axis,
+                **model_kw,
+            )
 
         n_train = self.dataset.n_train
         self.steps_per_epoch = config.steps_per_epoch or max(n_train // config.batch_size, 1)
@@ -219,6 +232,10 @@ class Trainer:
                 and config.sampler == "pool"
                 and config.score_refresh_every > 1
                 else 0
+            ),
+            with_scoretable=(
+                config.use_importance_sampling
+                and config.sampler == "scoretable"
             ),
         )
         params_sharded = tp > 1 or fs > 1
@@ -344,6 +361,8 @@ class Trainer:
                 has_cached_pool=(config.use_importance_sampling
                                  and config.sampler == "pool"
                                  and config.score_refresh_every > 1),
+                has_scoretable=(config.use_importance_sampling
+                                and config.sampler == "scoretable"),
             )
             if jax.process_count() == 1:
                 # Pre-place the whole state with the pinned shardings (a
@@ -362,6 +381,7 @@ class Trainer:
         self.train_step = make_train_step(
             self.model, self.tx, config, self.mesh, self.dataset.mean,
             self.dataset.std, state_out_shardings=self._state_out_shardings,
+            scoring_model=self.scoring_model,
         )
         # K-step chunked variant: one dispatch per config.scan_steps steps
         # (lax.scan over the same body; jit is lazy, so this costs nothing
@@ -381,6 +401,7 @@ class Trainer:
                 self.model, self.tx, config, self.mesh,
                 self.dataset.mean, self.dataset.std, scan_steps=self.scan_steps,
                 state_out_shardings=self._state_out_shardings,
+                scoring_model=self.scoring_model,
             )
             if self.scan_steps > 1
             else None
@@ -697,7 +718,15 @@ class Trainer:
         so the first post-restore step hits the jit cache (the input
         sharding signature is part of it) and the layout-stability
         invariant holds from step one. Shared by ``restore`` and
-        ``restore_elastic``."""
+        ``restore_elastic``.
+
+        Single-process restores must NOT skip this: the checkpoint
+        reader hands back host numpy leaves, and donating those into a
+        step executable replayed from the persistent compilation cache
+        corrupts the transient input buffers (NaN params or SIGSEGV on
+        the following step, jax 0.4.37 CPU). Committing the whole state
+        to the step's layout first makes the first donated call operate
+        on real device buffers."""
         if jax.process_count() > 1:
             from mercury_tpu.parallel.distributed import globalize_state
 
@@ -710,13 +739,37 @@ class Trainer:
                 self.state, self.mesh, self.config.mesh_axis,
                 zero_sharding=self.config.zero_sharding, **tp_kw,
             )
-        elif self._state_out_shardings is not None:
+            return
+        if self._state_out_shardings is not None:
             state_sh, _ = self._state_out_shardings
-            self.state = self.state.replace(
-                params=jax.device_put(self.state.params, state_sh.params),
-                opt_state=jax.device_put(self.state.opt_state,
-                                         state_sh.opt_state),
+        else:
+            # Non-TP: params/opt replicated, sampler state sharded over
+            # the data axis — the same layout the step program produces.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            from mercury_tpu.train.step import mercury_state_out_shardings
+
+            cfg = self.config
+            rep = NamedSharding(self.mesh, P())
+            state_sh, _ = mercury_state_out_shardings(
+                self.mesh, cfg.mesh_axis, rep, rep,
+                has_groupwise=(cfg.use_importance_sampling
+                               and cfg.sampler == "groupwise"),
+                has_pending=(cfg.use_importance_sampling
+                             and cfg.pipelined_scoring),
+                has_cached_pool=(cfg.use_importance_sampling
+                                 and cfg.sampler == "pool"
+                                 and cfg.score_refresh_every > 1),
+                has_scoretable=(cfg.use_importance_sampling
+                                and cfg.sampler == "scoretable"),
             )
+        # Identity jit, not a bare device_put: on CPU device_put may
+        # zero-copy alias the checkpoint reader's host buffers, and the
+        # first donated step would then hand XLA memory it doesn't own.
+        # Executable outputs are always XLA-allocated.
+        self.state = jax.jit(lambda s: s, out_shardings=state_sh)(
+            jax.device_put(self.state, state_sh)
+        )
 
     def restore_elastic(self, directory: Optional[str] = None,
                         step: Optional[int] = None, raw=None) -> int:

@@ -32,7 +32,8 @@ import jax  # noqa: E402
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
-from jax import lax, shard_map  # noqa: E402
+from jax import lax  # noqa: E402
+from mercury_tpu.compat import shard_map  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
 NPROC = 2

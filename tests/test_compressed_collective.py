@@ -12,10 +12,13 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from mercury_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mercury_tpu.parallel.collectives import compressed_allreduce_mean
+
+import pytest
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
 
 W = 8
 N = 1000  # deliberately not divisible by W — exercises the padding

@@ -11,9 +11,11 @@ import optax
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+from mercury_tpu.compat import shard_map
 
 from mercury_tpu.models.moe import MoEMLP
+
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
 
 B, T, D, E = 16, 8, 16, 8   # 8 experts over 4 devices → 2 experts/device
 

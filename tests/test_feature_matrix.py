@@ -13,6 +13,8 @@ from mercury_tpu.config import TrainConfig
 from mercury_tpu.parallel.mesh import host_cpu_mesh
 from mercury_tpu.train.trainer import Trainer
 
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
+
 W = 4
 
 COMBOS = {

@@ -20,6 +20,9 @@ from mercury_tpu.parallel.fsdp import (
 )
 from mercury_tpu.sampling.importance import per_sample_loss
 
+import pytest
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
+
 W = 8
 KW = dict(num_classes=5, d_model=64, num_heads=4, num_layers=2, max_len=16)
 

@@ -11,6 +11,7 @@ import sys
 
 import jax
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
@@ -24,6 +25,8 @@ def test_entry_compiles_and_runs():
     assert np.all(np.isfinite(np.asarray(out, np.float32)))
 
 
+@pytest.mark.slow  # ~3 min of arm compiles; the 2-device run below covers
+# every arm inside the tier-1 budget
 def test_dryrun_multichip_8():
     graft.dryrun_multichip(8)
 

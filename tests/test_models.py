@@ -13,6 +13,8 @@ from mercury_tpu.models import (
     create_model,
 )
 
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
+
 
 def param_count(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))

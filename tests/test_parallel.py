@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from mercury_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from mercury_tpu.parallel import (
@@ -16,6 +16,8 @@ from mercury_tpu.parallel import (
     ring_allreduce_sharded,
 )
 from mercury_tpu.parallel.mesh import host_cpu_mesh
+
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
 
 
 @pytest.fixture(scope="module")

@@ -21,6 +21,8 @@ from mercury_tpu.parallel.pipeline import (
 )
 from mercury_tpu.sampling.importance import per_sample_loss
 
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
+
 T, F, C, D, L = 16, 8, 5, 32, 4
 
 

@@ -13,6 +13,8 @@ from jax.sharding import Mesh
 from mercury_tpu.models import TransformerClassifier
 from mercury_tpu.train.pp_step import create_pp_state, make_pp_mercury_step
 
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
+
 T, F, C, D, L = 16, 8, 5, 32, 4
 
 

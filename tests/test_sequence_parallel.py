@@ -16,7 +16,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+from mercury_tpu.compat import shard_map
 
 from mercury_tpu.models import TransformerClassifier
 from mercury_tpu.parallel.sequence import (
@@ -24,6 +24,8 @@ from mercury_tpu.parallel.sequence import (
     ring_attention,
     ulysses_attention,
 )
+
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
 
 B, L, H, D = 2, 128, 2, 8   # global shapes; L shards 8-ways → 16 per device
 

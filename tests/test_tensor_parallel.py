@@ -16,6 +16,8 @@ from mercury_tpu.models import TransformerClassifier
 from mercury_tpu.parallel.tensor import shard_params_tp, transformer_tp_shardings
 from mercury_tpu.sampling.importance import per_sample_loss
 
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
+
 T, F, C, D = 32, 12, 5, 32
 
 

@@ -11,6 +11,8 @@ from mercury_tpu.config import TrainConfig
 from mercury_tpu.parallel.mesh import host_cpu_mesh
 from mercury_tpu.train.trainer import Trainer, build_dataset
 
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
+
 
 def tiny_config(**kw) -> TrainConfig:
     base = dict(

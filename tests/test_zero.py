@@ -18,6 +18,9 @@ from mercury_tpu.config import TrainConfig
 from mercury_tpu.parallel.mesh import host_cpu_mesh
 from mercury_tpu.train.trainer import Trainer
 
+import pytest
+pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
+
 W = 4
 
 
