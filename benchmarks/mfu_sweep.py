@@ -28,13 +28,9 @@ import _bootstrap  # noqa: F401
 
 import numpy as np  # noqa: E402
 
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6": 918e12,
-}
+# One source of truth for per-device peaks: the live MFU accounting and
+# this offline sweep must never disagree on the denominator.
+from mercury_tpu.obs.accounting import PEAK_FLOPS  # noqa: E402
 
 
 def measure(batch: int, args) -> dict:

@@ -1,0 +1,176 @@
+"""Cost of the in-graph telemetry: steps/s with ``telemetry`` on vs off,
+plus the compile-away proof for the off switch.
+
+The telemetry design claims two things:
+
+1. **On** costs ~nothing: ESS / clip-rate / EMA-drift / grad-norm /
+   table-age are a handful of reductions over arrays the step already
+   materializes, fused into the same program — the steps/s delta should
+   sit inside run-to-run noise (≤2% is the budget).
+2. **Off** costs *exactly* nothing: the gate is a Python ``if`` at trace
+   time, so ``telemetry=False`` traces the seed's program — same metric
+   keys, no extra outputs, no dead ops left for XLA to clean up. This is
+   checked structurally here (key set + lowered-text size), not assumed.
+
+CPU-runnable (8 virtual devices, the test-harness platform) so the
+numbers regenerate anywhere::
+
+    python benchmarks/telemetry_overhead.py [--calls 30]
+
+Appends one JSON record to ``results_telemetry_overhead.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+# CPU microbenchmark: force the 8-virtual-device host platform BEFORE the
+# bootstrap touches jax (same dance as tests/conftest.py).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import _bootstrap  # noqa: F401,E402
+
+import numpy as np  # noqa: E402
+
+# The seed step's metric surface — what telemetry=False must reproduce
+# exactly for the compile-away guarantee to hold.
+BASE_KEYS = {"train/loss", "train/acc", "train/pool_loss",
+             "train/sparse_rate", "train/moe_aux"}
+
+
+def build(telemetry: bool, args):
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        model=args.model,
+        dataset="synthetic",
+        world_size=args.world,
+        batch_size=args.batch,
+        presample_batches=3,
+        sampler=args.sampler,
+        num_epochs=1,
+        steps_per_epoch=10_000,
+        eval_every=0,
+        log_every=0,
+        scan_steps=1,
+        compute_dtype="float32",
+        telemetry=telemetry,
+        heartbeat_every=0,
+        seed=0,
+    )
+    return Trainer(config, mesh=make_mesh(args.world, config.mesh_axis))
+
+
+class Arm:
+    """One trainer plus its warm state; times blocks of ``calls`` steps."""
+
+    def __init__(self, trainer):
+        self.ds = trainer.dataset
+        self.step = trainer.train_step
+        self.state = trainer.state
+        ds = self.ds
+        for _ in range(3):
+            self.state, m = self.step(self.state, ds.x_train, ds.y_train,
+                                      ds.shard_indices)
+            np.asarray(m["train/loss"])
+        self.metric_keys = sorted(m)
+        lowered = self.step.lower(
+            self.state, ds.x_train, ds.y_train, ds.shard_indices
+        ).as_text()
+        self.lowered_lines = len(lowered.splitlines())
+        self.lowered_sha256 = hashlib.sha256(lowered.encode()).hexdigest()
+        self.rates = []
+
+    def run_block(self, calls: int) -> None:
+        ds = self.ds
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            self.state, m = self.step(self.state, ds.x_train, ds.y_train,
+                                      ds.shard_indices)
+        np.asarray(m["train/loss"])
+        self.rates.append(calls / (time.perf_counter() - t0))
+
+    @property
+    def steps_per_s(self) -> float:
+        # Median of interleaved blocks — robust to host-load drift, which
+        # on a shared CPU dwarfs the effect being measured.
+        r = sorted(self.rates)
+        return r[len(r) // 2]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="smallcnn")
+    ap.add_argument("--sampler", default="pool")
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--calls", type=int, default=10,
+                    help="steps per timed block")
+    ap.add_argument("--rounds", type=int, default=7,
+                    help="interleaved on/off block pairs; medians reported")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "results_telemetry_overhead.jsonl"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    on = Arm(build(True, args))
+    off = Arm(build(False, args))
+    for _ in range(args.rounds):
+        on.run_block(args.calls)
+        off.run_block(args.calls)
+
+    # Compile-away proof: the off switch restores the seed's exact metric
+    # surface and a strictly smaller program than telemetry-on.
+    assert set(off.metric_keys) == BASE_KEYS, off.metric_keys
+    assert set(on.metric_keys) > BASE_KEYS, on.metric_keys
+    assert off.lowered_lines < on.lowered_lines, (
+        off.lowered_lines, on.lowered_lines)
+
+    overhead_pct = 100.0 * (off.steps_per_s / on.steps_per_s - 1.0)
+    record = {
+        "schema": "telemetry_overhead_v1",
+        "model": args.model,
+        "sampler": args.sampler,
+        "world_size": args.world,
+        "batch_size": args.batch,
+        "calls": args.calls,
+        "rounds": args.rounds,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "telemetry_on_steps_per_s": round(on.steps_per_s, 3),
+        "telemetry_off_steps_per_s": round(off.steps_per_s, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "on_block_rates": [round(r, 3) for r in on.rates],
+        "off_block_rates": [round(r, 3) for r in off.rates],
+        "on_metric_keys": on.metric_keys,
+        "off_metric_keys": off.metric_keys,
+        "on_lowered_lines": on.lowered_lines,
+        "off_lowered_lines": off.lowered_lines,
+        "off_lowered_sha256": off.lowered_sha256,
+    }
+    with open(args.out, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record, indent=2))
+    if overhead_pct > 2.0:
+        print(f"# WARNING: telemetry overhead {overhead_pct:.2f}% exceeds "
+              "the 2% budget on this host (CPU timing is noisy — rerun "
+              "with more --calls before reading much into it)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

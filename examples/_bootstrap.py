@@ -17,3 +17,17 @@ sys.path.insert(
 from mercury_tpu.platform import select_cpu_if_requested  # noqa: E402
 
 select_cpu_if_requested()
+
+# Persistent compile cache, shared with the test harness and benchmarks:
+# a ResNet-scale fused step takes minutes of XLA time on a small host, and
+# the examples are exactly what gets re-run most — cache the executables.
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir,
+                                     ".jax_cache")),
+    ),
+)

@@ -17,18 +17,29 @@ from mercury_tpu.train import Trainer
 
 
 def main():
+    # Without an accelerator this script is a smoke run on (possibly one)
+    # host core: a single worker (emulated cross-device collectives
+    # dominate wall-clock otherwise), a short dispatch, and a dense log
+    # cadence so a few minutes of CPU still stream telemetry to log_dir.
+    on_cpu = jax.default_backend() == "cpu"
     config = TrainConfig(
         model="resnet18",
         dataset="cifar10",
-        world_size=min(4, len(jax.devices())),
+        world_size=1 if on_cpu else min(4, len(jax.devices())),
         noniid=True,                 # Dirichlet(0.5) per-class shards
-        scan_steps=25,               # 25 steps per device dispatch
+        sampler="scoretable",        # amortized full-shard IS (PR: scoretable)
+        scan_steps=5 if on_cpu else 25,   # steps per device dispatch
+        num_epochs=1 if on_cpu else 100,
+        steps_per_epoch=20 if on_cpu else None,  # bounded smoke on CPU
+        log_every=5 if on_cpu else 100,
+        heartbeat_every=5 if on_cpu else 100,
         checkpoint_dir="checkpoints/cifar10",
-        log_dir="logs/cifar10",
+        log_dir="logs/cifar10",      # metrics.jsonl + run_manifest.json;
+                                     # sampler/* + perf/* telemetry included
     )
-    trainer = Trainer(config)
-    print(f"run {config.run_name()} on mesh {trainer.mesh.shape}")
-    final = trainer.fit()
+    with Trainer(config) as trainer:
+        print(f"run {config.run_name()} on mesh {trainer.mesh.shape}")
+        final = trainer.fit()
     print(final)
 
 

@@ -101,19 +101,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from mercury_tpu.train.trainer import Trainer
 
-    trainer = Trainer(config)
-    print(f"run: {config.run_name()}  mesh: {trainer.mesh.shape}  "
-          f"steps/epoch: {trainer.steps_per_epoch}")
-    if args.dry_run:
-        state, metrics = trainer.train_step(
-            trainer.state, trainer._step_x, trainer._step_y,
-            trainer.dataset.shard_indices,
-        )
-        trainer.state = state
-        print(json.dumps({k: float(v) for k, v in metrics.items()}))
-        return 0
-    final = trainer.fit()
-    print(json.dumps(final))
+    # Context manager: drains + closes the async metric writer on exit
+    # (--log-every streams to log_dir, --heartbeat-every paces the stdout
+    # one-liner — both flags generated from TrainConfig above).
+    with Trainer(config) as trainer:
+        print(f"run: {config.run_name()}  mesh: {trainer.mesh.shape}  "
+              f"steps/epoch: {trainer.steps_per_epoch}")
+        if args.dry_run:
+            state, metrics = trainer.train_step(
+                trainer.state, trainer._step_x, trainer._step_y,
+                trainer.dataset.shard_indices,
+            )
+            trainer.state = state
+            print(json.dumps({k: float(v) for k, v in metrics.items()}))
+            return 0
+        final = trainer.fit()
+        print(json.dumps(final))
     return 0
 
 

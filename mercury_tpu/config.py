@@ -205,6 +205,19 @@ class TrainConfig:
     seed: int = 102                  # pytorch_collab.py:22
     eval_every: int = 200            # steps (pytorch_collab.py:181)
     log_every: int = 100             # steps (pytorch_collab.py:170)
+    # In-graph telemetry (obs/diagnostics.py): sampler-health scalars —
+    # ESS of the importance weights, score-clip fraction, EMA drift,
+    # global grad norm, and (scoretable sampler) table staleness — emitted
+    # from inside the fused step as extra metric outputs. Gated at TRACE
+    # time: with telemetry=False none of these ops exist in the compiled
+    # program (the jaxpr is identical to the seed step; verified by
+    # benchmarks/telemetry_overhead.py).
+    telemetry: bool = True
+    # Stdout heartbeat cadence (steps) for the async metric writer's
+    # rate-limited one-line progress print; 0 disables the heartbeat.
+    # Independent of log_every: metrics stream to JSONL/TensorBoard every
+    # log_every steps, the terminal line appears every heartbeat_every.
+    heartbeat_every: int = 100
     log_dir: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000     # steps; 0 disables

@@ -1,0 +1,65 @@
+"""Telemetry subsystem: in-graph sampler-health diagnostics, non-blocking
+metric streaming, and run accounting.
+
+Three layers (see ``docs/DESIGN.md`` §15):
+
+1. :mod:`~mercury_tpu.obs.diagnostics` — device-computed health scalars
+   (ESS, clip rate, EMA drift, score-table staleness, grad norm) emitted
+   from inside the fused step, gated by ``TrainConfig.telemetry`` so they
+   compile away when disabled.
+2. :mod:`~mercury_tpu.obs.writer` — :class:`AsyncMetricWriter`: bounded
+   queue + background drain thread, drop-oldest with a counted
+   ``dropped`` stat, fan-out to JSONL / TensorBoard / stdout-heartbeat
+   sinks.
+3. :mod:`~mercury_tpu.obs.manifest` / :mod:`~mercury_tpu.obs.accounting`
+   — the run manifest written at trainer start, and live steps/s /
+   examples/s / MFU on the log cadence.
+"""
+
+from mercury_tpu.obs.accounting import (
+    PEAK_FLOPS,
+    ThroughputMeter,
+    analytic_flops_per_step,
+    peak_flops,
+)
+from mercury_tpu.obs.diagnostics import (
+    clip_fraction,
+    ema_drift,
+    ess_fraction,
+    global_grad_norm,
+    table_age_summary,
+    table_ages,
+)
+from mercury_tpu.obs.manifest import (
+    build_run_manifest,
+    git_revision,
+    write_run_manifest,
+)
+from mercury_tpu.obs.writer import (
+    AsyncMetricWriter,
+    HeartbeatSink,
+    JsonlSink,
+    TensorBoardSink,
+    try_tensorboard_sink,
+)
+
+__all__ = [
+    "PEAK_FLOPS",
+    "ThroughputMeter",
+    "analytic_flops_per_step",
+    "peak_flops",
+    "clip_fraction",
+    "ema_drift",
+    "ess_fraction",
+    "global_grad_norm",
+    "table_age_summary",
+    "table_ages",
+    "build_run_manifest",
+    "git_revision",
+    "write_run_manifest",
+    "AsyncMetricWriter",
+    "HeartbeatSink",
+    "JsonlSink",
+    "TensorBoardSink",
+    "try_tensorboard_sink",
+]

@@ -1,0 +1,99 @@
+"""In-graph sampler-health diagnostics.
+
+Mercury's value proposition is that importance sampling buys variance
+reduction worth more than its scoring cost. These are the device-computed
+scalars that make that tradeoff visible *live*, from inside the fused
+step — no extra host syncs, no second program:
+
+- :func:`ess_fraction` — normalized effective sample size of the
+  importance weights, the canonical "is the IS estimator healthy" signal
+  (ESS → 1 means the draw is near-uniform; ESS → 1/B means one sample
+  dominates the batch and the variance reduction has inverted). This is
+  the quantity Katharopoulos & Fleuret (arXiv:1803.00942) build their
+  IS-on/off switch from.
+- :func:`clip_fraction` — fraction of candidate scores that hit the
+  numerical floor in :func:`~mercury_tpu.sampling.importance.
+  importance_probs`. Nonzero means the score distribution has collapsed
+  (all-zero losses with a zero EMA) and the draw is silently uniform.
+- :func:`ema_drift` — fresh score mean minus the pre-update EMA: how far
+  the running smoothing statistic lags the data. Large sustained drift
+  means the EMA horizon is mismatched to the loss decay rate.
+- :func:`table_age_summary` — min/mean/max staleness (in refresh sweeps)
+  of the scoretable sampler's entries, derived from the round-robin
+  cursor. Stale scores silently destroy the IS benefit (Alain et al.,
+  arXiv:1511.06481), and the scoretable sampler is structurally exposed
+  to staleness — this is its warning light.
+
+Everything here is pure jittable jnp math, safe inside ``shard_map``.
+All of it is gated behind ``TrainConfig.telemetry`` at trace time, so
+with telemetry off none of these ops exist in the compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mercury_tpu.sampling.importance import SCORE_FLOOR, smoothed_scores
+
+
+def ess_fraction(scaled_probs: jax.Array) -> jax.Array:
+    """Normalized effective sample size of the drawn batch's importance
+    weights: ``(Σw)² / (B·Σw²)`` with ``w_i = 1/(N·p_i)`` (the reweight
+    the training loss actually applies).
+
+    Returns a float32 scalar in ``(0, 1]``: 1.0 means uniform weights
+    (the uniform baseline's unit weights land exactly there), ``1/B``
+    means a single sample carries the whole batch."""
+    w = 1.0 / scaled_probs.astype(jnp.float32)
+    b = scaled_probs.shape[0]
+    return jnp.square(jnp.sum(w)) / (b * jnp.sum(jnp.square(w)) + 1e-30)
+
+
+def clip_fraction(scores: jax.Array, ema_value: jax.Array,
+                  alpha: float = 0.5) -> jax.Array:
+    """Fraction of candidates whose smoothed score ``loss + α·EMA`` sits
+    at/below the ``importance_probs`` floor — i.e. was clipped before
+    normalization. float32 scalar in ``[0, 1]``."""
+    s = smoothed_scores(scores, ema_value, alpha)
+    return jnp.mean((s <= SCORE_FLOOR).astype(jnp.float32))
+
+
+def ema_drift(fresh_mean: jax.Array, ema_prev: jax.Array) -> jax.Array:
+    """Signed drift of the fresh score mean from the pre-update EMA."""
+    return fresh_mean.astype(jnp.float32) - ema_prev.astype(jnp.float32)
+
+
+def table_age_summary(
+    cursor: jax.Array, n_slots: int, refresh_size: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(min, mean, max) age of the score table's entries, in refresh
+    sweeps (≈ steps), derived from the round-robin cursor.
+
+    ``cursor`` is the start of the window refreshed THIS step, so slots
+    ``[cursor, cursor+R)`` have age 0 and the slot just behind the window
+    is the oldest. This is the cursor-derived upper bound: the free
+    write-back of the just-trained batch re-scores a few extra slots each
+    step, which this summary deliberately ignores (it tracks the
+    *guaranteed* refresh schedule, not the lucky draws)."""
+    ages = table_ages(cursor, n_slots, refresh_size)
+    return jnp.min(ages), jnp.mean(ages), jnp.max(ages)
+
+
+def table_ages(cursor: jax.Array, n_slots: int,
+               refresh_size: int) -> jax.Array:
+    """Per-slot age ``[L]`` (float32, in refresh sweeps) behind the
+    newest refreshed slot ``cursor + R - 1``: slots inside this step's
+    window age 0, the window refreshed one step ago age 1, …"""
+    newest = cursor + refresh_size - 1
+    behind = jnp.mod(newest - jnp.arange(n_slots), n_slots)
+    return (behind // refresh_size).astype(jnp.float32)
+
+
+def global_grad_norm(grads) -> jax.Array:
+    """L2 norm of a (post-allreduce) gradient pytree — float32 scalar."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves) + 0.0)

@@ -1,0 +1,304 @@
+"""Non-blocking metric streaming: a bounded queue drained off-thread.
+
+The seed's ``MetricsLogger`` fetched every metric to the host and
+``flush()``-ed JSONL from the training thread on every logged step — a
+host sync and a filesystem write sitting directly on the critical path.
+Here the trainer enqueues the *on-device* metric pytree and returns; a
+background thread performs the ``jax.device_get`` (blocking on the device
+only when the step that produced the values has actually finished — the
+async-dispatch queue keeps training ahead) and fans the host record out
+to sinks.
+
+Backpressure policy is drop-oldest with a counted ``dropped`` stat: a
+slow sink (NFS log dir, wedged TensorBoard) can never stall training,
+and the loss of records is visible in the stream itself
+(``obs/dropped``) rather than silent.
+
+Sinks implement ``write(record: dict) -> None`` and ``close() -> None``;
+records are flat ``tag → float`` dicts carrying ``step`` and ``time``.
+Provided sinks: :class:`JsonlSink` (buffered), :class:`TensorBoardSink`
+(when a TB writer is importable), :class:`HeartbeatSink` (rate-limited
+stdout one-liner).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, Iterable, Optional
+
+
+def _to_host_record(step: int, t: float, scalars: Dict) -> Dict[str, float]:
+    """device_get + reduce: each value becomes one float (scan-chunked
+    ``[K]`` metric series reduce to their mean — the same reduction the
+    seed trainer applied inside its log gate)."""
+    import numpy as np
+
+    import jax
+
+    record: Dict[str, float] = {"step": int(step), "time": float(t)}
+    host = jax.device_get(scalars)
+    for k, v in host.items():
+        record[k] = float(np.mean(np.asarray(v)))
+    return record
+
+
+class AsyncMetricWriter:
+    """Bounded-queue, background-thread metric writer.
+
+    ``write(step, scalars)`` enqueues the (possibly device-resident)
+    scalar dict and returns immediately; the drain thread converts to a
+    host record and fans out to every sink, in enqueue order. When the
+    queue is full the OLDEST pending record is dropped and counted
+    (``.dropped``); the count is attached to subsequent records as
+    ``obs/dropped`` so the gap is visible in the stream.
+
+    ``close()`` drains whatever is queued, closes the sinks, and is
+    idempotent; the instance is also a context manager. The drain thread
+    spawns lazily on the first :meth:`write` (an idle writer costs
+    nothing). ``start=False`` disables that entirely — records queue and
+    only :meth:`flush`/:meth:`close` drain them, synchronously
+    (deterministic unit testing of the queue policy).
+    """
+
+    def __init__(self, sinks: Iterable, capacity: int = 256,
+                 start: bool = True) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.sinks = [s for s in sinks if s is not None]
+        self.capacity = capacity
+        self.dropped = 0
+        self.errors = 0
+        self._q: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._have_work = threading.Condition(self._lock)
+        self._stop = False
+        self._closed = False
+        self._autostart = start
+        self._thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------------------- plumbing
+    def start(self) -> None:
+        if self._thread is None and not self._closed:
+            self._thread = threading.Thread(
+                target=self._drain_loop, name="mercury-metrics", daemon=True
+            )
+            self._thread.start()
+
+    def write(self, step: int, scalars: Dict) -> None:
+        """Enqueue one step's scalar dict (device arrays welcome) —
+        returns without touching the device or the filesystem."""
+        if self._closed:
+            return
+        if self._thread is None and self._autostart:
+            self.start()
+        with self._have_work:
+            if len(self._q) >= self.capacity:
+                self._q.popleft()
+                self.dropped += 1
+            self._q.append((int(step), time.time(), scalars))
+            self._have_work.notify()
+
+    def log_scalars(self, step: int, scalars: Dict) -> None:
+        """``MetricsLogger``-compatible alias for :meth:`write`."""
+        self.write(step, scalars)
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Block until every record enqueued so far has been written to
+        the sinks (and ask buffered sinks to hit the filesystem)."""
+        deadline = time.monotonic() + timeout
+        if self._thread is None:
+            self._drain_pending()
+        else:
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._q and not self._busy:
+                        break
+                time.sleep(0.005)
+        for s in self.sinks:
+            flush = getattr(s, "flush", None)
+            if flush is not None:
+                try:
+                    flush()
+                except Exception:
+                    self.errors += 1
+
+    def close(self) -> None:
+        """Drain, stop the thread, close every sink. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._have_work:
+            self._stop = True
+            self._have_work.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+        self._drain_pending()
+        for s in self.sinks:
+            try:
+                s.close()
+            except Exception:
+                self.errors += 1
+
+    def __enter__(self) -> "AsyncMetricWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ----------------------------------------------------------------- drain
+    _busy = False
+
+    def _emit(self, item) -> None:
+        step, t, scalars = item
+        try:
+            record = _to_host_record(step, t, scalars)
+            if self.dropped:
+                record["obs/dropped"] = float(self.dropped)
+        except Exception:
+            self.errors += 1
+            return
+        for s in self.sinks:
+            try:
+                s.write(record)
+            except Exception:
+                self.errors += 1
+
+    def _drain_pending(self) -> None:
+        while True:
+            with self._lock:
+                if not self._q:
+                    return
+                item = self._q.popleft()
+            self._emit(item)
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._have_work:
+                while not self._q and not self._stop:
+                    self._have_work.wait(timeout=0.5)
+                if not self._q and self._stop:
+                    return
+                item = self._q.popleft()
+                self._busy = True
+            try:
+                self._emit(item)
+            finally:
+                with self._lock:
+                    self._busy = False
+                    idle = not self._q
+            # Flush-on-idle: under sustained load sink buffering batches
+            # filesystem work; the moment the queue drains, records become
+            # durable — still entirely off the training thread.
+            if idle:
+                for s in self.sinks:
+                    flush = getattr(s, "flush", None)
+                    if flush is not None:
+                        try:
+                            flush()
+                        except Exception:
+                            self.errors += 1
+
+
+# ------------------------------------------------------------------- sinks
+class JsonlSink:
+    """Buffered JSONL: one record per line, flushed every
+    ``flush_every`` records or on ``flush()``/``close()`` — not per
+    record (the seed logger's per-step ``flush()`` is the behavior this
+    replaces)."""
+
+    def __init__(self, log_dir: str, filename: str = "metrics.jsonl",
+                 flush_every: int = 32) -> None:
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = open(os.path.join(log_dir, filename), "a")
+        self._since_flush = 0
+        self.flush_every = max(int(flush_every), 1)
+
+    def write(self, record: Dict[str, float]) -> None:
+        if self._f is None:
+            return
+        self._f.write(json.dumps(record) + "\n")
+        self._since_flush += 1
+        if self._since_flush >= self.flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            self._since_flush = 0
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class TensorBoardSink:
+    """Scalar fan-out to a TensorBoard event file. Construct via
+    :func:`try_tensorboard_sink` — TB is an optional dependency and the
+    framework must not require it."""
+
+    def __init__(self, tb_writer) -> None:
+        self._tb = tb_writer
+
+    def write(self, record: Dict[str, float]) -> None:
+        step = int(record["step"])
+        for tag, value in record.items():
+            if tag in ("step", "time"):
+                continue
+            self._tb.add_scalar(tag, float(value), step)
+
+    def flush(self) -> None:
+        self._tb.flush()
+
+    def close(self) -> None:
+        self._tb.close()
+
+
+def try_tensorboard_sink(log_dir: str) -> Optional[TensorBoardSink]:
+    from mercury_tpu.utils.logging import _try_tensorboard_writer
+
+    tb = _try_tensorboard_writer(log_dir)
+    return TensorBoardSink(tb) if tb is not None else None
+
+
+class HeartbeatSink:
+    """Rate-limited stdout one-liner — the replacement for the trainer's
+    synchronous per-log print. Emits at most once per ``every_steps``
+    steps AND at most once per ``min_interval_s`` seconds, so a fast
+    small-model run cannot flood the terminal from the drain thread."""
+
+    _KEYS = ("train/loss", "train/acc", "perf/steps_per_s",
+             "perf/examples_per_s", "perf/mfu", "sampler/ess")
+
+    def __init__(self, every_steps: int = 100, min_interval_s: float = 1.0,
+                 stream=None) -> None:
+        self.every_steps = max(int(every_steps), 1)
+        self.min_interval_s = float(min_interval_s)
+        self._stream = stream if stream is not None else sys.stdout
+        self._last_step: Optional[int] = None
+        self._last_t = 0.0
+
+    def write(self, record: Dict[str, float]) -> None:
+        step = int(record["step"])
+        if self._last_step is not None:
+            if step // self.every_steps <= self._last_step // self.every_steps:
+                return
+            if time.monotonic() - self._last_t < self.min_interval_s:
+                return
+        self._last_step, self._last_t = step, time.monotonic()
+        parts = [f"step {step}"]
+        if "epoch" in record:
+            parts.append(f"epoch {int(record['epoch'])}")
+        for key in self._KEYS:
+            if key in record:
+                short = key.split("/")[-1]
+                parts.append(f"{short} {record[key]:.4g}")
+        print("  ".join(parts), file=self._stream, flush=True)
+
+    def close(self) -> None:
+        pass

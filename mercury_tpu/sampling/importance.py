@@ -38,6 +38,13 @@ import jax
 import jax.numpy as jnp
 
 
+# Numerical floor applied to smoothed scores before normalization
+# (guards the all-zero pool). Shared with the telemetry clip-rate
+# diagnostic (obs/diagnostics.py) so "clipped" means exactly "floored
+# here" — the two cannot drift apart.
+SCORE_FLOOR = 1e-12
+
+
 class EMAState(NamedTuple):
     """In-graph EMA with first-update bootstrap (``util.py:200-217``)."""
 
@@ -100,17 +107,28 @@ def per_sample_grad_norm_bound(
     return jnp.linalg.norm(p - target, axis=-1)
 
 
+def smoothed_scores(
+    losses: jax.Array, ema_value: jax.Array, alpha: float = 0.5
+) -> jax.Array:
+    """The additive smoothing ``score_i = loss_i + α·EMA``
+    (``pytorch_collab.py:111``) — the pre-normalization scores every
+    sampler draws from. Factored out so the telemetry clip-rate
+    diagnostic measures exactly the quantity ``importance_probs``
+    floors."""
+    return losses.astype(jnp.float32) + alpha * ema_value
+
+
 def importance_probs(
     losses: jax.Array, ema_value: jax.Array, alpha: float = 0.5
 ) -> jax.Array:
     """Scores → normalized sampling distribution over the candidate pool.
 
     ``score_i = loss_i + α·EMA`` (``pytorch_collab.py:111``) then
-    ``p = score / Σ score`` (``:112``). Losses are ≥0 so scores are ≥0; a
-    tiny floor guards the all-zero edge case.
+    ``p = score / Σ score`` (``:112``). Losses are ≥0 so scores are ≥0;
+    the ``SCORE_FLOOR`` guards the all-zero edge case.
     """
-    scores = losses.astype(jnp.float32) + alpha * ema_value
-    scores = jnp.maximum(scores, 1e-12)
+    scores = jnp.maximum(smoothed_scores(losses, ema_value, alpha),
+                         SCORE_FLOOR)
     return scores / jnp.sum(scores)
 
 

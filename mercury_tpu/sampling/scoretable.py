@@ -66,6 +66,15 @@ def init_score_table(n_slots: int) -> ScoreTableState:
     )
 
 
+def refresh_period(n_slots: int, refresh_size: int) -> int:
+    """``ceil(L/R)`` — steps for the round-robin window to sweep the whole
+    shard, i.e. the guaranteed staleness bound: no entry's cursor-age ever
+    exceeds ``refresh_period - 1`` sweeps. The telemetry age summary
+    (``obs.diagnostics.table_age_summary``) reports live ages against this
+    bound."""
+    return -(-n_slots // refresh_size)
+
+
 def refresh_window(state: ScoreTableState, refresh_size: int) -> jax.Array:
     """Shard slots of the next refresh window, wrapping modularly.
 

@@ -86,6 +86,7 @@ def make_pp_mercury_step(
     is_alpha: float = 0.5,
     ema_alpha: float = 0.9,
     moe_aux_weight: float = TrainConfig.moe_aux_weight,
+    telemetry: bool = False,
 ) -> Callable[..., Tuple[PPMercuryState, dict]]:
     """Build ``step(state, x_train, y_train) → (state, metrics)``.
 
@@ -104,6 +105,11 @@ def make_pp_mercury_step(
     explicitly — this factory takes keywords, not a ``TrainConfig``. The
     scoring pass discards the aux (scores are per-sample CE, matching
     ``pytorch_collab.py:102``).
+
+    ``telemetry=True`` adds the fused dp step's sampler-health scalars
+    (``sampler/ess``, ``sampler/clip_frac``, ``sampler/ema_drift``,
+    ``train/grad_norm`` — see ``obs/diagnostics.py``); gated at trace
+    time, so the default traces the original program.
     """
     pool_size = presample_batches * batch_size
     if pool_size % num_microbatches or batch_size % num_microbatches:
@@ -159,11 +165,28 @@ def make_pp_mercury_step(
             step=state.step + 1, stacked=stacked, rest=rest,
             opt_state=opt_state, ema=sel.ema, stream=stream, rng=k_next,
         )
-        return new_state, {
+        metrics = {
             "train/loss": loss,
             "train/acc": acc,
             "train/pool_loss": sel.avg_pool_loss,
             "train/moe_aux": moe_aux,
         }
+        if telemetry:
+            from mercury_tpu.obs.diagnostics import (
+                clip_fraction,
+                ema_drift,
+                ess_fraction,
+                global_grad_norm,
+            )
+
+            metrics["sampler/ess"] = ess_fraction(sel.scaled_probs)
+            metrics["sampler/clip_frac"] = clip_fraction(
+                pool_losses, sel.ema.value, is_alpha
+            )
+            metrics["sampler/ema_drift"] = ema_drift(
+                sel.avg_pool_loss, state.ema.value
+            )
+            metrics["train/grad_norm"] = global_grad_norm(grads)
+        return new_state, metrics
 
     return jax.jit(step, donate_argnums=donate_argnums(0))

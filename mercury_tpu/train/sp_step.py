@@ -159,6 +159,7 @@ def make_dp_sp_mercury_step(
     moe_aux_weight: float = TrainConfig.moe_aux_weight,
     data_axis: str = "data",
     seq_axis: str = "seq",
+    telemetry: bool = False,
 ) -> Callable[..., Tuple["SpMercuryState", dict]]:
     """The FULL Mercury IS algorithm on a 2-D ``data × seq`` mesh —
     completing the composition matrix's IS×SP cell (IS×TP and IS×PP
@@ -195,6 +196,12 @@ def make_dp_sp_mercury_step(
     applied inside the jitted program, like
     :func:`make_dp_sp_train_step`). ``T`` must divide by the seq axis
     size.
+
+    ``telemetry=True`` adds the fused dp step's sampler-health scalars
+    (``sampler/ess``, ``sampler/clip_frac``, ``sampler/ema_drift``,
+    ``train/grad_norm`` — see ``obs/diagnostics.py``) to the metrics
+    dict; gated at trace time, so the default traces the original
+    program.
     """
     pool_size = presample_batches * batch_size
     w_seq = mesh.shape[seq_axis]
@@ -286,6 +293,27 @@ def make_dp_sp_mercury_step(
             # with axis_name=data_axis) — no extra collective needed.
             "train/pool_loss": sel.avg_pool_loss,
         }
+        if telemetry:
+            from mercury_tpu.obs.diagnostics import (
+                clip_fraction,
+                ema_drift,
+                ess_fraction,
+                global_grad_norm,
+            )
+
+            metrics["sampler/ess"] = lax.pmean(
+                ess_fraction(sel.scaled_probs), data_axis
+            )
+            metrics["sampler/clip_frac"] = lax.pmean(
+                clip_fraction(pool_losses, sel.ema.value, is_alpha),
+                data_axis,
+            )
+            metrics["sampler/ema_drift"] = ema_drift(
+                sel.avg_pool_loss, ema.value
+            )
+            # grads are already the global mean (psum/W above) —
+            # replicated, so the norm needs no further collective.
+            metrics["train/grad_norm"] = global_grad_norm(grads)
         return new_state, metrics
 
     state_specs = SpMercuryState(

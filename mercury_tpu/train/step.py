@@ -31,6 +31,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from mercury_tpu.config import TrainConfig
 from mercury_tpu.data.pipeline import ShardStream, augment_batch, next_pool, normalize_images
+from mercury_tpu.obs.diagnostics import (
+    clip_fraction,
+    ema_drift,
+    ess_fraction,
+    global_grad_norm,
+    table_age_summary,
+)
 from mercury_tpu.parallel.collectives import allreduce_mean_tree
 from mercury_tpu.sampling.importance import (
     EMAState,
@@ -150,6 +157,11 @@ def make_train_step(
     pool_size = config.candidate_pool_size if use_is else config.batch_size
     batch_size = config.batch_size
     stat_axis = axis if (use_is and config.sync_importance_stats) else None
+    # In-graph telemetry is gated at TRACE time: with telemetry=False every
+    # diagnostic below is simply never traced, so the compiled program is
+    # identical to the seed step (no reliance on XLA DCE — verified by
+    # benchmarks/telemetry_overhead.py comparing jaxprs).
+    telemetry = bool(config.telemetry)
 
     # Mesh axes beyond the data axis (e.g. the "model" axis of a dp×tp
     # mesh) are left to GSPMD: the step is manual-SPMD over `axis` only,
@@ -370,6 +382,13 @@ def make_train_step(
         stream = ShardStream(perm=state.stream.perm[0], cursor=state.stream.cursor[0])
         ema = EMAState(value=state.ema.value[0], count=state.ema.count[0])
 
+        # Per-path sampler-health scalars (obs/diagnostics.py). Each branch
+        # overwrites these with its own measurement; the uniform baseline
+        # keeps the zeros (nothing is scored, nothing can clip or drift).
+        if telemetry:
+            clip_frac = jnp.zeros((), jnp.float32)
+            drift = jnp.zeros((), jnp.float32)
+
         def score_slots(slots, ka):
             """Gather → augment → inference-mode scoring forward — the
             pool-scoring prologue shared by the inline, pipelined,
@@ -408,14 +427,23 @@ def make_train_step(
             def score_next(stream, ema, ks, ka, ksel):
                 stream, slots = next_pool(stream, ks, pool_size)
                 imgs, labs, pool_logits, pool_losses = score_slots(slots, ka)
+                ema_prev = ema.value
                 selected, scaled, ema, avg = _select(ksel, pool_losses, ema)
                 pend = PendingBatch(
                     images=imgs[selected], labels=labs[selected],
                     scaled_probs=scaled,
                 )
+                tel = ()
+                if telemetry:
+                    # Clip/drift of the pool scored THIS step (the one
+                    # trained next step) — the pipeline's live scoring work.
+                    tel = (
+                        clip_fraction(pool_losses, ema.value, config.is_alpha),
+                        ema_drift(avg, ema_prev),
+                    )
                 return stream, ema, pend, _pool_loss_metric(
                     pool_logits, labs, avg
-                )
+                ), tel
 
             stored = jax.tree_util.tree_map(lambda x: x[0], state.pending)
 
@@ -427,16 +455,22 @@ def make_train_step(
 
             def keep(args):
                 s, e = args
-                return s, e, stored, jnp.zeros((), jnp.float32)
+                tel = ()
+                if telemetry:
+                    tel = (jnp.zeros((), jnp.float32),
+                           jnp.zeros((), jnp.float32))
+                return s, e, stored, jnp.zeros((), jnp.float32), tel
 
-            stream, ema, current, _ = lax.cond(
+            stream, ema, current, _, _ = lax.cond(
                 state.step == 0, boot, keep, (stream, ema)
             )
             sel_images, sel_labels = current.images, current.labels
             scaled_probs = current.scaled_probs
-            stream, ema, new_pending, avg_pool_loss = score_next(
+            stream, ema, new_pending, avg_pool_loss, tel = score_next(
                 stream, ema, k_stream, k_aug, k_sel
             )
+            if telemetry:
+                clip_frac, drift = tel
         elif use_cadence:
             # --- score-refresh cadence: every K-th step stream + score a
             # fresh pool and cache its normalized importance distribution;
@@ -447,14 +481,21 @@ def make_train_step(
             # reweight uses the cached probs the batch was actually drawn
             # from, so the estimator stays unbiased for those scores. ----
             cached = jax.tree_util.tree_map(lambda x: x[0], state.cached_pool)
+            # Telemetry carry through the cond: the refresh branch measures,
+            # the reuse branch returns these zeros — clip/drift read 0 on
+            # cache-hit steps (no scoring happened, nothing to measure).
+            tel0 = ()
+            if telemetry:
+                tel0 = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
 
             def refresh(args):
-                stream, ema, _ = args
+                stream, ema, _, _ = args
                 stream, slots = next_pool(stream, k_stream, pool_size)
                 _, labs, pool_logits, pool_losses = score_slots(
                     slots, k_aug
                 )
                 avg = pool_mean(pool_losses, stat_axis)
+                ema_prev = ema.value
                 ema = ema_update(ema, avg, config.ema_alpha)
                 probs = importance_probs(
                     pool_losses, ema.value, config.is_alpha
@@ -464,15 +505,23 @@ def make_train_step(
                     probs=probs,
                     pool_loss=_pool_loss_metric(pool_logits, labs, avg),
                 )
-                return stream, ema, pool
+                tel = ()
+                if telemetry:
+                    tel = (
+                        clip_fraction(pool_losses, ema.value, config.is_alpha),
+                        ema_drift(avg, ema_prev),
+                    )
+                return stream, ema, pool, tel
 
             def reuse(args):
                 return args
 
-            stream, ema, cached = lax.cond(
+            stream, ema, cached, tel = lax.cond(
                 state.step % cadence == 0, refresh, reuse,
-                (stream, ema, cached),
+                (stream, ema, cached, tel0),
             )
+            if telemetry:
+                clip_frac, drift = tel
             selected = draw_with_replacement(k_sel, cached.probs, batch_size)
             scaled_probs = cached.probs[selected] * pool_size
             sel_raw, sel_labels = gather_train(cached.slots[selected])
@@ -497,6 +546,7 @@ def make_train_step(
                 refresh_slots, k_aug
             )
             score_avg = pool_mean(r_scores, stat_axis)
+            ema_prev = ema.value
             ema = ema_update(ema, score_avg, config.ema_alpha)
             if use_pallas:
                 from mercury_tpu.ops import table_refresh_draw_pallas
@@ -521,6 +571,17 @@ def make_train_step(
             avg_pool_loss = _pool_loss_metric(r_logits, r_labels, score_avg)
             table_scores_predraw = new_scores
             table_selected = selected
+            if telemetry:
+                # Clip over the FULL refreshed table — the distribution the
+                # draw actually normalizes — and staleness from the
+                # round-robin cursor (pre-advance: this window is age 0).
+                clip_frac = clip_fraction(
+                    new_scores, ema.value, config.is_alpha
+                )
+                drift = ema_drift(score_avg, ema_prev)
+                age_min, age_mean, age_max = table_age_summary(
+                    table.cursor, table.scores.shape[0], refresh_size
+                )
         else:
             if use_groupwise:
                 # Sliding-window refresh over the shard (util.py:114-138):
@@ -558,11 +619,13 @@ def make_train_step(
                         k_aug2, normalize_images(sel_raw, mean, std)
                     )
                     score_avg = pool_mean(pool_losses, stat_axis)
+                    ema_prev = ema.value
                     ema = ema_update(ema, score_avg, config.ema_alpha)
                     avg_pool_loss = _pool_loss_metric(
                         pool_logits, labels, score_avg
                     )
                 else:
+                    ema_prev = ema.value
                     selected, scaled_probs, ema, score_avg = _select(
                         k_sel, pool_losses, ema
                     )
@@ -571,6 +634,11 @@ def make_train_step(
                     )
                     sel_images = images[selected]
                     sel_labels = labels[selected]
+                if telemetry:
+                    clip_frac = clip_fraction(
+                        pool_losses, ema.value, config.is_alpha
+                    )
+                    drift = ema_drift(score_avg, ema_prev)
             else:
                 # Uniform baseline: consume the freshly streamed batch
                 # directly — the stream is a shuffled without-replacement
@@ -674,6 +742,13 @@ def make_train_step(
                 )
             else:
                 gchunk = lax.psum_scatter(pad_to_chunks(gvec, w), axis) / w
+            if telemetry:
+                # The chunks partition the full mean-gradient vector (the
+                # pad is zeros), so psum of the per-chunk square-sums is the
+                # exact global norm² — one scalar on the wire.
+                grad_norm = jnp.sqrt(lax.psum(
+                    jnp.sum(jnp.square(gchunk.astype(jnp.float32))), axis
+                ))
             pvec, _ = tree_flatten_to_vector(state.params)
             pchunk = pad_to_chunks(pvec, w)[lax.axis_index(axis)]
             updates_chunk, new_opt_chunk = tx.update(gchunk, opt_chunk, pchunk)
@@ -717,6 +792,10 @@ def make_train_step(
                     )
             else:
                 grads = allreduce_mean_tree(grads, axis)
+            if telemetry:
+                # Post-allreduce: already the worker-mean gradient, so the
+                # norm is identical on every worker (replicated output).
+                grad_norm = global_grad_norm(grads)
             updates, new_opt_state = tx.update(
                 grads, state.opt_state, state.params
             )
@@ -757,6 +836,19 @@ def make_train_step(
             "train/sparse_rate": lax.pmean(sparse_rate, axis),
             "train/moe_aux": lax.pmean(moe_aux, axis),
         }
+        if telemetry:
+            metrics["sampler/ess"] = lax.pmean(
+                ess_fraction(scaled_probs), axis
+            )
+            metrics["sampler/clip_frac"] = lax.pmean(clip_frac, axis)
+            metrics["sampler/ema_drift"] = lax.pmean(drift, axis)
+            metrics["train/grad_norm"] = grad_norm
+            if use_scoretable:
+                # Cursor-derived, identical on every worker (the cursors
+                # advance in lockstep from the same init).
+                metrics["sampler/table_age_min"] = age_min
+                metrics["sampler/table_age_mean"] = age_mean
+                metrics["sampler/table_age_max"] = age_max
         return new_state, metrics
 
     if scan_steps > 1:

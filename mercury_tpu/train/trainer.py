@@ -17,7 +17,10 @@ Parity map:
 - ``evaluate`` (``:201-234``): full pass over train and test sets in
   inference mode, loss/accuracy meters.
 - rank-0 printing/TensorBoard every 100/200 steps (``:170-195``) →
-  ``MetricsLogger`` + stdout, same cadences, same tags.
+  non-blocking metric streaming (``obs/writer.py``: JSONL + TensorBoard +
+  a rate-limited stdout heartbeat on ``heartbeat_every``), same tags; a
+  run manifest and steps/s / examples/s / MFU accounting ride along
+  (``obs/manifest.py``, ``obs/accounting.py``).
 - wall-clock segment timing (``step/ff/is/bp/sync``, ``:129-168``): a fused
   XLA step has no host-visible segment boundaries — the trainer reports
   true ``step_time`` and throughput; per-segment attribution lives in
@@ -27,7 +30,6 @@ Parity map:
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional
 
 import jax
@@ -39,11 +41,18 @@ from mercury_tpu.data import cifar
 from mercury_tpu.data.partition import partition_data
 from mercury_tpu.data.pipeline import ShardedDataset, eval_batches, make_sharded_dataset
 from mercury_tpu.models import create_model
+from mercury_tpu.obs.accounting import ThroughputMeter, analytic_flops_per_step
+from mercury_tpu.obs.manifest import write_run_manifest
+from mercury_tpu.obs.writer import (
+    AsyncMetricWriter,
+    HeartbeatSink,
+    JsonlSink,
+    try_tensorboard_sink,
+)
 from mercury_tpu.parallel.mesh import make_mesh
 from mercury_tpu.train import checkpoint as ckpt
 from mercury_tpu.train.state import MercuryState, create_state, make_optimizer
 from mercury_tpu.train.step import make_eval_epoch, make_eval_step, make_train_step
-from mercury_tpu.utils.logging import MetricsLogger
 
 
 def build_dataset(config: TrainConfig, seed_offset: int = 0) -> ShardedDataset:
@@ -422,7 +431,27 @@ class Trainer:
                                           else "none",
                                           mesh=eval_mesh,
                                           axis=config.mesh_axis)
-        self.logger = MetricsLogger(config.log_dir)
+        # --- observability: run manifest + non-blocking metric stream ---
+        # The manifest (resolved config, jax/jaxlib versions, mesh/device
+        # topology, git sha) makes the metrics stream interpretable later;
+        # the AsyncMetricWriter replaces the seed's synchronous per-log
+        # float()+flush() with an enqueue — device_get and filesystem IO
+        # happen on a background thread (obs/writer.py).
+        sinks = []
+        if config.log_dir and jax.process_index() == 0:
+            write_run_manifest(config.log_dir, config, self.mesh)
+            sinks.append(JsonlSink(config.log_dir))
+            sinks.append(try_tensorboard_sink(config.log_dir))
+        if config.heartbeat_every and jax.process_index() == 0:
+            sinks.append(HeartbeatSink(every_steps=config.heartbeat_every))
+        self.logger = AsyncMetricWriter(sinks)
+        # steps/s, examples/s, MFU between log ticks; the analytic FLOPs
+        # estimate is filled in lazily at the first log gate (the step has
+        # compiled by then, so lower().compile() is a jit-cache hit).
+        self._throughput = ThroughputMeter(
+            examples_per_step=config.batch_size * config.world_size,
+        )
+        self._flops_known = False
         self.history: List[Dict[str, float]] = []
         # Round up to a multiple of world_size so the sharded-eval batch
         # dimension always divides the mesh axis (e.g. world_size=5 → 260).
@@ -485,7 +514,7 @@ class Trainer:
         cfg = self.config
         num_epochs = num_epochs or cfg.num_epochs
         step = int(self.state.step)
-        last_log_t, last_log_step = time.perf_counter(), step
+        self._throughput.reset(step)
         final_metrics: Dict[str, float] = {}
 
         # End of the run: num_epochs' worth of steps from here, clipped by
@@ -528,28 +557,31 @@ class Trainer:
                     )
                 step += k
                 if crossed(cfg.log_every, step, k):
-                    # Scanned chunks deliver each metric as a [K] series
-                    # (one entry per step); log the chunk MEAN — keeping
-                    # only the last entry would silently discard (K-1)/K
-                    # of the signal. The reduction happens here, inside
-                    # the log gate, so unlogged chunks dispatch nothing.
-                    metrics = {name: float(jnp.mean(v))
-                               for name, v in metrics.items()}
-                    now = time.perf_counter()
-                    step_time = (now - last_log_t) / max(step - last_log_step, 1)
-                    last_log_t, last_log_step = now, step
-                    metrics["time/step"] = step_time
-                    metrics["time/images_per_sec"] = (
-                        cfg.batch_size * cfg.world_size / step_time
-                    )
-                    self.logger.log_scalars(step, metrics)
-                    epoch = (step - 1) // self.steps_per_epoch
-                    print(
-                        f"epoch {epoch} step {step} "
-                        f"loss {metrics['train/loss']:.4f} "
-                        f"acc {metrics['train/acc']:.4f} "
-                        f"step_time {step_time*1000:.1f}ms"
-                    )
+                    if not self._flops_known:
+                        # First log gate: ask XLA's cost model for the
+                        # step program's FLOPs (re-traces but does NOT
+                        # re-compile — see analytic_flops_per_step),
+                        # enabling perf/mfu.
+                        fn, ks = ((self.train_step_many, self.scan_steps)
+                                  if k > 1 else (self.train_step, 1))
+                        self._throughput.flops_per_step = (
+                            analytic_flops_per_step(
+                                fn, self.state, self._step_x, self._step_y,
+                                self.dataset.shard_indices, scan_steps=ks,
+                            )
+                        )
+                        self._flops_known = True
+                    # Enqueue the ON-DEVICE metric pytree: no float(), no
+                    # device sync, no filesystem write on this thread. The
+                    # drain thread device_gets and reduces scanned [K]
+                    # metric series to their chunk MEAN (keeping only the
+                    # last entry would discard (K-1)/K of the signal) —
+                    # obs/writer.py:_to_host_record. Safe to hold: metric
+                    # outputs are not donated (only the state is).
+                    record = dict(metrics)
+                    record.update(self._throughput.tick(step))
+                    record["epoch"] = (step - 1) // self.steps_per_epoch
+                    self.logger.write(step, record)
                 if crossed(cfg.eval_every, step, k):
                     final_metrics = self.evaluate()
                     self.logger.log_scalars(step, final_metrics)
@@ -575,11 +607,26 @@ class Trainer:
             if self._ckpt_thread is not None:
                 self._ckpt_thread.join()
                 self._ckpt_thread = None
+            # Drain the metric queue to the sinks so callers (and crashed
+            # runs' postmortems) see every step logged up to here. The
+            # writer itself stays open — fit() can be called again.
+            self.logger.flush()
         if not final_metrics:
             final_metrics = self.evaluate()
         if cfg.checkpoint_dir:
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
         return final_metrics
+
+    def close(self) -> None:
+        """Drain and close the metric writer (idempotent). A trainer also
+        works as a context manager: ``with Trainer(cfg) as t: t.fit()``."""
+        self.logger.close()
+
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # ----------------------------------------------------------------- eval
     def _eval_arrays(self, train: bool):

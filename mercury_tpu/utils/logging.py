@@ -5,7 +5,14 @@ Capability parity with the reference's rank-0 TensorBoardX scalar logging
 ``train/loss``, ``test/loss`` keyed by step) plus stdout prints
 (``:170-178``). Writes step-keyed scalars to a JSONL file always, and to
 TensorBoard event files when a TensorBoard writer is importable (it is an
-optional dependency; the framework must not require it)."""
+optional dependency; the framework must not require it).
+
+:class:`MetricsLogger` is the simple synchronous logger (buffered JSONL,
+flushed every ``flush_every`` records or on close). The trainer's hot
+loop uses the non-blocking :class:`mercury_tpu.obs.writer.
+AsyncMetricWriter` instead; this class remains for offline/analysis
+scripts and as the drop-in minimal logger.
+"""
 
 from __future__ import annotations
 
@@ -25,10 +32,23 @@ def _try_tensorboard_writer(log_dir: str):
 
 
 class MetricsLogger:
-    """Step-keyed scalar logger: JSONL always, TensorBoard when available."""
+    """Step-keyed scalar logger: JSONL always, TensorBoard when available.
 
-    def __init__(self, log_dir: Optional[str], enabled: bool = True) -> None:
+    JSONL writes are buffered: the file is flushed every ``flush_every``
+    records and on :meth:`close` — not per record (a per-step ``flush()``
+    puts a filesystem sync on the training loop's critical path; see
+    ``obs/writer.py`` for where the hot loop's logging actually went).
+    ``close()`` is idempotent, and the logger is a context manager::
+
+        with MetricsLogger(log_dir) as logger:
+            logger.log_scalars(step, {"train/loss": 0.3})
+    """
+
+    def __init__(self, log_dir: Optional[str], enabled: bool = True,
+                 flush_every: int = 32) -> None:
         self.enabled = enabled and log_dir is not None
+        self.flush_every = max(int(flush_every), 1)
+        self._since_flush = 0
         self._tb = None
         self._jsonl = None
         if self.enabled:
@@ -39,18 +59,36 @@ class MetricsLogger:
     def log_scalars(self, step: int, scalars: Dict[str, float]) -> None:
         """Log a dict of tag→value at ``step`` (tags like ``train/acc``,
         mirroring ``pytorch_collab.py:187-190``)."""
-        if not self.enabled:
+        if not self.enabled or self._jsonl is None:
             return
         record = {"step": int(step), "time": time.time()}
         record.update({k: float(v) for k, v in scalars.items()})
         self._jsonl.write(json.dumps(record) + "\n")
-        self._jsonl.flush()
+        self._since_flush += 1
+        if self._since_flush >= self.flush_every:
+            self.flush()
         if self._tb is not None:
             for tag, value in scalars.items():
                 self._tb.add_scalar(tag, float(value), int(step))
 
+    def flush(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.flush()
+            self._since_flush = 0
+        if self._tb is not None:
+            self._tb.flush()
+
     def close(self) -> None:
+        """Flush buffered records and close the file. Idempotent."""
         if self._jsonl is not None:
             self._jsonl.close()
+            self._jsonl = None
         if self._tb is not None:
             self._tb.close()
+            self._tb = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -60,7 +60,23 @@ def main(port: str, pid: int) -> None:
         )
 
     total = shard_map(contrib, mesh=mesh, in_specs=(), out_specs=P())
-    psum_val = float(jax.jit(total)())
+    try:
+        psum_val = float(jax.jit(total)())
+    except Exception as e:  # pragma: no cover - backend-dependent
+        # Some jaxlib CPU builds can FORM a multiprocess cluster but not
+        # EXECUTE cross-process collectives ("Multiprocess computations
+        # aren't implemented on the CPU backend"). That is an environment
+        # limitation, not a bug in parallel/distributed.py — surface it as
+        # an explicit skip marker for the parent test, matched narrowly so
+        # any other failure still fails loudly.
+        if "Multiprocess computations aren't implemented" in str(e):
+            print(
+                "SKIP: jax CPU backend cannot execute cross-process "
+                f"collectives in this build ({type(e).__name__})",
+                flush=True,
+            )
+            return
+        raise
     assert psum_val == 12.0, psum_val
 
     # 2. grad-allreduce shape: each worker row holds a distinct value;

@@ -1,0 +1,332 @@
+"""Telemetry subsystem unit tests: in-graph diagnostics math, the
+non-blocking metric writer's queue policy, and run accounting.
+
+Everything here is pure-CPU and fast — no model, no train step. The
+diagnostics are checked against independent numpy derivations (not
+against themselves), and the writer tests use ``start=False`` so the
+queue policy is observed deterministically without thread timing.
+"""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.obs.accounting import (
+    ThroughputMeter,
+    analytic_flops_per_step,
+    peak_flops,
+)
+from mercury_tpu.obs.diagnostics import (
+    clip_fraction,
+    ema_drift,
+    ess_fraction,
+    global_grad_norm,
+    table_age_summary,
+    table_ages,
+)
+from mercury_tpu.obs.manifest import build_run_manifest, write_run_manifest
+from mercury_tpu.obs.writer import (
+    AsyncMetricWriter,
+    HeartbeatSink,
+    JsonlSink,
+)
+from mercury_tpu.sampling.scoretable import refresh_period
+
+
+# ------------------------------------------------------------- diagnostics
+class TestEssFraction:
+    def test_uniform_weights_are_exactly_one(self):
+        # The uniform baseline feeds scaled_probs == 1 (unit weights):
+        # ESS must land exactly at 1.0, not merely near it.
+        assert float(ess_fraction(jnp.ones(64))) == 1.0
+
+    def test_equal_nonunit_probs_still_one(self):
+        b = 16
+        probs = jnp.full((b,), 1.0 / b)
+        assert float(ess_fraction(probs)) > 0.999
+
+    def test_single_dominant_sample_approaches_one_over_b(self):
+        b = 32
+        # One tiny scaled prob → one huge weight dominating the batch.
+        probs = jnp.ones(b).at[0].set(1e-6)
+        ess = float(ess_fraction(probs))
+        assert abs(ess - 1.0 / b) < 1e-3
+
+    def test_matches_numpy_formula(self, rng):
+        probs = rng.uniform(0.1, 2.0, size=24).astype(np.float32)
+        w = 1.0 / probs
+        expect = (w.sum() ** 2) / (24 * (w**2).sum())
+        assert abs(float(ess_fraction(jnp.asarray(probs))) - expect) < 1e-5
+
+
+class TestClipFraction:
+    def test_counts_floored_scores(self):
+        # With EMA 0 and alpha 0.5, smoothed score == loss: the two zero
+        # losses sit at/below the floor, the positive one doesn't.
+        scores = jnp.array([0.0, 0.0, 1.0])
+        ema = jnp.zeros(())
+        assert abs(float(clip_fraction(scores, ema, 0.5)) - 2 / 3) < 1e-6
+
+    def test_positive_ema_lifts_everything_off_floor(self):
+        scores = jnp.zeros(8)
+        ema = jnp.asarray(2.0)
+        assert float(clip_fraction(scores, ema, 0.5)) == 0.0
+
+
+class TestEmaDrift:
+    def test_signed_difference(self):
+        assert float(ema_drift(jnp.asarray(3.0), jnp.asarray(1.0))) == 2.0
+        assert float(ema_drift(jnp.asarray(0.5), jnp.asarray(1.0))) == -0.5
+
+
+class TestTableAges:
+    def test_window_is_age_zero_and_oldest_is_period_minus_one(self):
+        n_slots, refresh = 12, 3
+        period = refresh_period(n_slots, refresh)  # 4 sweeps cover the table
+        ages = np.asarray(table_ages(jnp.asarray(0), n_slots, refresh))
+        # This step's window [0, 3) is fresh.
+        assert ages[:refresh].tolist() == [0.0, 0.0, 0.0]
+        # The slot just behind the window is the oldest.
+        assert ages.max() == period - 1
+        assert ages[refresh] == period - 1
+
+    def test_cursor_advance_rotates_ages(self):
+        n_slots, refresh = 12, 3
+        a0 = np.asarray(table_ages(jnp.asarray(0), n_slots, refresh))
+        a1 = np.asarray(table_ages(jnp.asarray(refresh), n_slots, refresh))
+        # One refresh later every slot's age pattern rotates by one window.
+        assert np.array_equal(np.roll(a0, refresh), a1)
+
+    def test_summary_min_mean_max(self):
+        n_slots, refresh = 10, 3
+        mn, mean, mx = table_age_summary(jnp.asarray(3), n_slots, refresh)
+        ages = np.asarray(table_ages(jnp.asarray(3), n_slots, refresh))
+        assert float(mn) == ages.min() == 0.0
+        assert float(mx) == ages.max()
+        assert abs(float(mean) - ages.mean()) < 1e-6
+
+
+class TestGlobalGradNorm:
+    def test_matches_flat_l2_over_pytree(self, rng):
+        tree = {
+            "w": jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32)),
+            "b": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+        }
+        flat = np.concatenate([np.asarray(v).ravel() for v in tree.values()])
+        assert abs(float(global_grad_norm(tree))
+                   - np.linalg.norm(flat)) < 1e-5
+
+
+# ------------------------------------------------------------------ writer
+class ListSink:
+    def __init__(self):
+        self.records = []
+        self.closed = 0
+
+    def write(self, record):
+        self.records.append(record)
+
+    def close(self):
+        self.closed += 1
+
+
+class TestAsyncMetricWriter:
+    def test_records_arrive_in_order(self):
+        sink = ListSink()
+        w = AsyncMetricWriter([sink], start=False)
+        for step in range(1, 6):
+            w.write(step, {"train/loss": float(step)})
+        w.flush()
+        assert [r["step"] for r in sink.records] == [1, 2, 3, 4, 5]
+        assert [r["train/loss"] for r in sink.records] == [1, 2, 3, 4, 5]
+
+    def test_bounded_queue_drops_oldest_and_counts(self):
+        sink = ListSink()
+        w = AsyncMetricWriter([sink], capacity=3, start=False)
+        for step in range(1, 6):
+            w.write(step, {"v": step})
+        assert w.dropped == 2
+        w.flush()
+        # Oldest two (steps 1, 2) were dropped; survivors carry the count.
+        assert [r["step"] for r in sink.records] == [3, 4, 5]
+        assert all(r["obs/dropped"] == 2.0 for r in sink.records)
+
+    def test_device_arrays_and_chunk_series_reduce_to_floats(self):
+        sink = ListSink()
+        w = AsyncMetricWriter([sink], start=False)
+        # Scan-chunked [K] series must reduce to the chunk mean.
+        w.write(7, {"train/loss": jnp.array([1.0, 2.0, 3.0]),
+                    "train/acc": jnp.asarray(0.5)})
+        w.flush()
+        (rec,) = sink.records
+        assert rec["train/loss"] == 2.0
+        assert rec["train/acc"] == 0.5
+        assert isinstance(rec["train/loss"], float)
+
+    def test_background_thread_drains_and_close_joins(self):
+        sink = ListSink()
+        before = threading.active_count()
+        w = AsyncMetricWriter([sink])
+        # Lazy start: no thread until the first write.
+        assert threading.active_count() == before
+        for step in range(1, 4):
+            w.write(step, {"v": step})
+        w.close()
+        assert [r["step"] for r in sink.records] == [1, 2, 3]
+        assert sink.closed == 1
+
+    def test_close_is_idempotent_and_write_after_close_is_noop(self):
+        sink = ListSink()
+        w = AsyncMetricWriter([sink], start=False)
+        w.write(1, {"v": 1})
+        w.close()
+        w.close()
+        w.write(2, {"v": 2})
+        assert [r["step"] for r in sink.records] == [1]
+        assert sink.closed == 1
+
+    def test_context_manager_closes(self):
+        sink = ListSink()
+        with AsyncMetricWriter([sink], start=False) as w:
+            w.log_scalars(1, {"v": 1.0})  # MetricsLogger-compatible alias
+        assert sink.closed == 1
+        assert sink.records[0]["v"] == 1.0
+
+    def test_failing_sink_never_raises_into_caller(self):
+        class Boom:
+            def write(self, record):
+                raise RuntimeError("sink down")
+
+            def close(self):
+                raise RuntimeError("still down")
+
+        ok = ListSink()
+        w = AsyncMetricWriter([Boom(), ok], start=False)
+        w.write(1, {"v": 1})
+        w.flush()
+        w.close()
+        assert [r["step"] for r in ok.records] == [1]
+        assert w.errors >= 1
+
+    def test_none_sinks_are_filtered(self):
+        # try_tensorboard_sink returns None when TB is absent; the
+        # writer must accept that directly.
+        w = AsyncMetricWriter([None, ListSink()], start=False)
+        assert len(w.sinks) == 1
+        w.close()
+
+
+class TestJsonlSink:
+    def test_buffered_writes_land_on_close(self, tmp_path):
+        sink = JsonlSink(str(tmp_path), flush_every=100)
+        sink.write({"step": 1, "train/loss": 2.5})
+        sink.write({"step": 2, "train/loss": 2.0})
+        sink.close()
+        lines = (tmp_path / "metrics.jsonl").read_text().splitlines()
+        recs = [json.loads(l) for l in lines]
+        assert [r["step"] for r in recs] == [1, 2]
+        assert recs[0]["train/loss"] == 2.5
+        sink.close()  # idempotent
+
+
+class TestHeartbeatSink:
+    def test_rate_limited_by_step_cadence(self):
+        out = io.StringIO()
+        hb = HeartbeatSink(every_steps=2, min_interval_s=0.0, stream=out)
+        for step in range(1, 7):
+            hb.write({"step": step, "train/loss": 1.0, "sampler/ess": 0.9})
+        lines = out.getvalue().splitlines()
+        # First record always prints; then only on every_steps boundaries.
+        assert lines[0].startswith("step 1")
+        assert [l.split()[1] for l in lines] == ["1", "2", "4", "6"]
+        assert "ess 0.9" in lines[0]
+
+
+# -------------------------------------------------------------- accounting
+class TestThroughputMeter:
+    def test_tick_math_with_explicit_clock(self):
+        m = ThroughputMeter(examples_per_step=10, flops_per_step=1e9,
+                            device_kind="TPU v4")
+        m.reset(0, now=100.0)
+        out = m.tick(10, now=102.0)  # 10 steps in 2 s
+        assert out["perf/steps_per_s"] == 5.0
+        assert out["perf/examples_per_s"] == 50.0
+        assert out["time/step"] == 0.2
+        assert out["perf/flops_per_step"] == 1e9
+        assert abs(out["perf/mfu"] - 1e9 * 5.0 / 275e12) < 1e-18
+
+    def test_unknown_device_reports_zero_mfu(self):
+        m = ThroughputMeter(examples_per_step=8, flops_per_step=1e9,
+                            device_kind="CPU-of-some-kind")
+        m.reset(0, now=0.0)
+        out = m.tick(4, now=1.0)
+        assert out["perf/mfu"] == 0.0
+        assert out["perf/steps_per_s"] == 4.0
+
+    def test_first_tick_without_reset_is_empty(self):
+        m = ThroughputMeter(examples_per_step=8)
+        assert m.tick(5, now=1.0) == {}
+        assert m.tick(10, now=2.0)["perf/steps_per_s"] == 5.0
+
+
+class TestPeakFlops:
+    def test_known_and_unknown_kinds(self):
+        assert peak_flops("TPU v4") == 275e12
+        assert peak_flops("TPU v5 lite") == 197e12
+        assert peak_flops("Intel Xeon") is None
+        assert peak_flops(None) is None
+
+
+class TestAnalyticFlops:
+    def test_jitted_matmul_reports_positive_flops(self):
+        import jax
+
+        @jax.jit
+        def f(a, b):
+            return a @ b
+
+        a = jnp.ones((16, 16))
+        flops = analytic_flops_per_step(f, a, a)
+        # CPU's cost model may legitimately be absent (None); when it
+        # answers, the number must be positive and scale down with scan.
+        if flops is not None:
+            assert flops > 0
+            assert analytic_flops_per_step(f, a, a, scan_steps=2) == flops / 2
+
+    def test_unlowerable_fn_returns_none(self):
+        assert analytic_flops_per_step(lambda x: x, 1.0) is None
+
+
+# ---------------------------------------------------------------- manifest
+class TestRunManifest:
+    def test_build_has_required_fields(self):
+        import jax
+
+        from mercury_tpu.parallel.mesh import make_mesh
+
+        config = TrainConfig(model="smallcnn", dataset="synthetic",
+                             world_size=2, batch_size=8)
+        mesh = make_mesh(2, config.mesh_axis)
+        man = build_run_manifest(config, mesh, extra={"note": "test"})
+        assert man["schema"] == "mercury_run_manifest_v1"
+        assert man["config"]["model"] == "smallcnn"
+        assert man["jax_version"] == jax.__version__
+        assert man["mesh_shape"] == {config.mesh_axis: 2}
+        assert man["device_count"] == jax.device_count()
+        assert man["note"] == "test"
+        assert "peak_flops" in man  # null on CPU — but always present
+
+    def test_write_produces_json_file(self, tmp_path):
+        config = TrainConfig(model="smallcnn", dataset="synthetic",
+                             world_size=1, batch_size=8)
+        path = write_run_manifest(str(tmp_path), config)
+        assert os.path.basename(path) == "run_manifest.json"
+        man = json.loads(open(path).read())
+        assert man["run_name"] == config.run_name()
+        assert man["config"]["batch_size"] == 8
