@@ -40,11 +40,45 @@ from typing import Optional
 import numpy as np
 
 __all__ = [
+    "collective_footprint",
     "conditional_variance",
     "exact_variance_probe",
     "estimate_is_benefit",
     "recommend",
 ]
+
+
+def collective_footprint(fn, *args) -> dict:
+    """Structural footprint of the program ``fn(*args)`` traces: exact
+    per-primitive collective counts (global and per ``mercury_*`` named
+    scope), host-callback count, and the canonicalized jaxpr digest.
+
+    A thin probe over the graftlint auditor's jaxpr walker
+    (:mod:`mercury_tpu.lint.audit`) for interactive use: before
+    committing to a parallelism plan, check what its step actually puts
+    on the wire — the same measurement CI pins via
+    ``lint/budgets.json``, but on *your* step function and config::
+
+        fp = collective_footprint(trainer.train_step, trainer.state,
+                                  ds.x_train, ds.y_train,
+                                  ds.shard_indices)
+        fp["collectives"]          # {"psum": 26, ...}
+        fp["host_callbacks"]       # 0 unless telemetry streams callbacks
+    """
+    from mercury_tpu.lint.audit import measure_step
+
+    m = measure_step(fn, args, plan="adhoc", config={})
+    return {
+        "collectives": dict(sorted(m.collectives.items())),
+        "scoped_collectives": {
+            k: dict(sorted(v.items()))
+            for k, v in m.scoped_collectives.items()
+        },
+        "host_callbacks": m.host_callbacks,
+        "donation_markers": m.donation_markers,
+        "jaxpr_sha256": m.jaxpr_sha256,
+        "metric_keys": m.metric_keys,
+    }
 
 
 def conditional_variance(probs, gnorm_sq, gbar_sq, n_pool, batch_size):

@@ -55,6 +55,11 @@ def parse_config(argv: Optional[Sequence[str]] = None) -> tuple[TrainConfig, arg
                         help="initialize jax.distributed for multi-host pods")
     parser.add_argument("--dry-run", action="store_true",
                         help="build everything, run one step, print metrics, exit")
+    parser.add_argument("--audit", action="store_true",
+                        help="build everything, trace (don't run) the train "
+                             "step, print its structural footprint — "
+                             "collective counts, host callbacks, jaxpr "
+                             "digest (see docs/LINT.md) — and exit")
     parser.add_argument("--print-config", action="store_true",
                         help="print the resolved config as JSON and exit")
     args = parser.parse_args(argv)
@@ -107,6 +112,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     with Trainer(config) as trainer:
         print(f"run: {config.run_name()}  mesh: {trainer.mesh.shape}  "
               f"steps/epoch: {trainer.steps_per_epoch}")
+        if args.audit:
+            from mercury_tpu.analysis import collective_footprint
+
+            fp = collective_footprint(
+                trainer.train_step, trainer.state, trainer._step_x,
+                trainer._step_y, trainer.dataset.shard_indices,
+            )
+            print(json.dumps(fp, indent=2))
+            return 0
         if args.dry_run:
             state, metrics = trainer.train_step(
                 trainer.state, trainer._step_x, trainer._step_y,

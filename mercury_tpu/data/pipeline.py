@@ -83,6 +83,7 @@ def random_crop_to_batch(key: jax.Array, images: jax.Array, out: int) -> jax.Arr
     (the IID path crops a larger resized image, ``exp_dataset.py:26-27``)."""
     n, h, w, _ = images.shape
     oy = jax.random.randint(key, (n,), 0, h - out + 1)
+    # graftlint: disable=GL101 -- fold_in(key, 1) is a stream disjoint from the raw key; raw+folded pairing is deliberate to keep recorded augmentation trajectories stable
     ox = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, w - out + 1)
     return _take_crops(images, oy, ox, out, out)
 
@@ -101,6 +102,7 @@ def cutout_batch(key: jax.Array, images: jax.Array, length: int) -> jax.Array:
     borders, exactly like the reference's ``np.clip`` logic."""
     n, h, w, _ = images.shape
     cy = jax.random.randint(key, (n,), 0, h)
+    # graftlint: disable=GL101 -- fold_in(key, 1) is a stream disjoint from the raw key; raw+folded pairing is deliberate to keep recorded augmentation trajectories stable
     cx = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, w)
     ys = jnp.arange(h)[None, :, None]
     xs = jnp.arange(w)[None, None, :]

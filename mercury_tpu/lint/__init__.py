@@ -1,0 +1,45 @@
+"""graftlint: static analysis for the JAX hazards this codebase lives with.
+
+Two layers, one entry point (``python -m mercury_tpu.lint``):
+
+- **Layer 1** (:mod:`mercury_tpu.lint.rules`, :mod:`mercury_tpu.lint.engine`)
+  is an AST rule engine over the package's own source with JAX-specific
+  rules: PRNG-key reuse, host syncs inside traced functions, Python
+  branches on tracer values, mutable default args, unordered iteration
+  feeding pytree/array construction, use-after-donation, trace-time
+  closure over mutable module globals, eager log formatting. Findings are
+  suppressible inline with ``# graftlint: disable=RULE -- reason`` (the
+  reason is mandatory — an unexplained suppression is itself a finding).
+  Layer 1 is pure stdlib: it never imports jax, so it runs anywhere in
+  milliseconds.
+
+- **Layer 2** (:mod:`mercury_tpu.lint.audit`) traces the fused train step
+  (and its ZeRO / bf16-scoring / sequence-parallel / pipeline-parallel
+  variants) on CPU and checks *structural invariants of the traced
+  program* as data: per-plan collective count/kind budgets, zero host
+  callbacks, donation aliasing where configured, no f32 matmuls inside
+  ``scoring_dtype=bf16`` regions, and a byte-identical jaxpr digest for
+  ``telemetry=False`` against the committed seed digest. Budgets live in
+  the committed ``lint/budgets.json`` golden file (regenerate with
+  ``--regen``), so program drift is a reviewed diff, not a surprise.
+
+See ``docs/LINT.md`` for the rule catalog and ``docs/DESIGN.md`` for the
+audit invariants.
+"""
+
+from mercury_tpu.lint.engine import (
+    Finding,
+    format_findings,
+    lint_paths,
+    lint_source,
+)
+from mercury_tpu.lint.rules import RULES, Rule
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "format_findings",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,7 @@
+"""``python -m mercury_tpu.lint`` entry point."""
+
+import sys
+
+from mercury_tpu.lint.cli import main
+
+sys.exit(main())

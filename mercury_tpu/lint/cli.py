@@ -1,0 +1,114 @@
+"""graftlint command line: ``python -m mercury_tpu.lint``.
+
+Exit codes: 0 clean, 1 findings / budget mismatch, 2 internal error.
+
+Layer selection:
+
+- ``--layer ast`` (default): Layer 1 over the given paths (default: the
+  ``mercury_tpu`` package). Pure stdlib — never initializes jax.
+- ``--layer audit``: Layer 2 — trace the parallelism-plan matrix on CPU
+  and verify against the committed ``lint/budgets.json`` (``--regen`` to
+  re-record it after an intentional program change).
+- ``--layer all``: both.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mercury_tpu.lint",
+        description="graftlint: JAX-hazard AST linter (Layer 1) + "
+                    "jaxpr/HLO structural auditor (Layer 2)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories for Layer 1 (default: the "
+                         "mercury_tpu package)")
+    ap.add_argument("--layer", choices=("ast", "audit", "all"),
+                    default="ast")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="RULE",
+                    help="restrict Layer 1 to these rule IDs/slugs "
+                         "(repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the Layer 1 rule catalog and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--plans", default=None,
+                    help="comma-separated audit plans "
+                         "(default: dp,zero,dp_bf16,sp,pp)")
+    ap.add_argument("--budgets", default=None, metavar="PATH",
+                    help="budgets.json to verify against / regenerate")
+    ap.add_argument("--regen", action="store_true",
+                    help="re-measure and WRITE budgets.json instead of "
+                         "verifying (review the diff before committing)")
+    ap.add_argument("--diff-out", default=None, metavar="PATH",
+                    help="write the audit diff to this file on mismatch "
+                         "(CI artifact)")
+    args = ap.parse_args(argv)
+
+    from mercury_tpu.lint.rules import RULES
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{rule.id} [{rule.slug}] {rule.summary}")
+            print(f"    fix: {rule.hint}")
+        return 0
+
+    rc = 0
+    if args.layer in ("ast", "all"):
+        from mercury_tpu.lint.engine import format_findings, lint_paths
+
+        paths = args.paths or [_package_root()]
+        findings = lint_paths(paths, select=args.select)
+        if args.as_json:
+            print(json.dumps([f.__dict__ for f in findings], indent=2))
+        else:
+            print(format_findings(findings))
+        if findings:
+            rc = 1
+
+    if args.layer in ("audit", "all"):
+        from mercury_tpu.lint import audit
+
+        plans = (tuple(p.strip() for p in args.plans.split(","))
+                 if args.plans else audit.PLAN_NAMES)
+        unknown = [p for p in plans if p not in audit.PLAN_NAMES]
+        if unknown:
+            print(f"unknown audit plan(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(audit.PLAN_NAMES)})",
+                  file=sys.stderr)
+            return 2
+        try:
+            errors, warnings = audit.run_audit(
+                plans=plans, budgets_path=args.budgets,
+                regen=args.regen, diff_out=args.diff_out)
+        except FileNotFoundError as exc:
+            print(f"graftlint audit: budgets file missing ({exc}) — "
+                  "run with --regen first", file=sys.stderr)
+            return 2
+        for line in warnings:
+            print(f"warning: {line}")
+        for line in errors:
+            print(line)
+        if errors:
+            rc = 1
+        else:
+            print(f"graftlint audit: {len(plans)} plan(s) verified "
+                  f"({', '.join(plans)})")
+
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

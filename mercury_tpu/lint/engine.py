@@ -1,0 +1,201 @@
+"""graftlint Layer 1 driver: file discovery, suppression handling, output.
+
+Pure stdlib (no jax import — see :mod:`mercury_tpu.lint.rules`).
+
+Suppression syntax, parsed from the token stream so strings containing
+the marker don't count::
+
+    x = noisy()  # graftlint: disable=GL101 -- deliberate sentinel stream
+    # graftlint: disable=GL104,GL105 -- frozen at import, never mutated
+    y = other()    # ^ a standalone suppression comment covers the NEXT line
+    # graftlint: disable-file=GL108 -- generated file, cold path only
+
+The ``-- reason`` is mandatory and the rule list must name known rule IDs
+or slugs; anything else is itself a finding (GL100), so a suppression can
+never silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from mercury_tpu.lint.rules import RULES, RawFinding, run_rules
+
+__all__ = ["Finding", "lint_source", "lint_paths", "format_findings"]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(?P<kind>disable(?:-file)?)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\-\s]*?)"
+    r"(?:\s*--\s*(?P<reason>.*))?$"
+)
+_MARKER_RE = re.compile(r"#\s*graftlint\b")
+
+_SLUG_TO_ID = {r.slug: r.id for r in RULES.values()}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One reportable lint finding, located and suppressible."""
+
+    rule_id: str
+    slug: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.rule_id} [{self.slug}] {self.message}\n"
+                f"    fix: {self.hint}")
+
+
+@dataclass
+class _Suppressions:
+    per_line: Dict[int, Set[str]]
+    file_wide: Set[str]
+    bad: List[Tuple[int, str]]  # (line, why it's malformed)
+
+
+def _resolve_rules(spec: str) -> Tuple[Set[str], List[str]]:
+    ids: Set[str] = set()
+    unknown: List[str] = []
+    for token in (t.strip() for t in spec.split(",")):
+        if not token:
+            continue
+        rid = token if token in RULES else _SLUG_TO_ID.get(token)
+        if rid is None:
+            unknown.append(token)
+        else:
+            ids.add(rid)
+    return ids, unknown
+
+
+def _parse_suppressions(source: str) -> _Suppressions:
+    sup = _Suppressions(per_line={}, file_wide=set(), bad=[])
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sup
+    # Lines that hold only a comment (suppression applies to next line).
+    code_lines: Set[int] = set()
+    for tok in tokens:
+        if tok.type not in (tokenize.COMMENT, tokenize.NL,
+                            tokenize.NEWLINE, tokenize.INDENT,
+                            tokenize.DEDENT, tokenize.ENCODING,
+                            tokenize.ENDMARKER):
+            for ln in range(tok.start[0], tok.end[0] + 1):
+                code_lines.add(ln)
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        if not _MARKER_RE.search(tok.string):
+            continue
+        line = tok.start[0]
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            sup.bad.append(
+                (line, "unrecognized graftlint directive — expected "
+                       "`# graftlint: disable=RULE -- reason`"))
+            continue
+        reason = (m.group("reason") or "").strip()
+        ids, unknown = _resolve_rules(m.group("rules"))
+        if unknown:
+            sup.bad.append(
+                (line, f"unknown rule(s) {', '.join(unknown)} in "
+                       "suppression"))
+            continue
+        if not ids:
+            sup.bad.append((line, "suppression names no rules"))
+            continue
+        if not reason:
+            sup.bad.append(
+                (line, f"suppression of {', '.join(sorted(ids))} has no "
+                       "reason — append `-- why this is intentional`"))
+            continue
+        if m.group("kind") == "disable-file":
+            sup.file_wide |= ids
+        else:
+            target = line if line in code_lines else line + 1
+            sup.per_line.setdefault(target, set()).update(ids)
+    return sup
+
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one module's source. Returns unsuppressed findings (plus a
+    GL100 finding per malformed suppression)."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        gl100 = RULES["GL100"]
+        return [Finding("GL999", "syntax-error", path,
+                        exc.lineno or 0, (exc.offset or 1) - 1,
+                        f"file does not parse: {exc.msg}", gl100.hint)]
+    sup = _parse_suppressions(source)
+    raw = run_rules(tree, select=select)
+    for f in raw:
+        if f.rule.id in sup.file_wide:
+            continue
+        if f.rule.id in sup.per_line.get(f.line, ()):
+            continue
+        findings.append(Finding(f.rule.id, f.rule.slug, path, f.line,
+                                f.col, f.message, f.rule.hint))
+    gl100 = RULES["GL100"]
+    want_gl100 = select is None or "GL100" in select \
+        or "bad-suppression" in select
+    if want_gl100 and "GL100" not in sup.file_wide:
+        for line, why in sup.bad:
+            if "GL100" in sup.per_line.get(line, ()):
+                continue
+            findings.append(Finding(gl100.id, gl100.slug, path, line, 0,
+                                    why, gl100.hint))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule_id))
+    return findings
+
+
+def _iter_py_files(paths: Iterable[Path]) -> Iterable[Path]:
+    for p in paths:
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every ``*.py`` under the given files/directories."""
+    findings: List[Finding] = []
+    for file in _iter_py_files(Path(p) for p in paths):
+        try:
+            source = file.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as exc:
+            gl100 = RULES["GL100"]
+            findings.append(Finding(
+                "GL999", "unreadable", str(file), 0, 0,
+                f"cannot read file: {exc}", gl100.hint))
+            continue
+        findings.extend(lint_source(source, path=str(file), select=select))
+    return findings
+
+
+def format_findings(findings: Sequence[Finding]) -> str:
+    if not findings:
+        return "graftlint: clean (0 findings)"
+    lines = [f.format() for f in findings]
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    tally = ", ".join(f"{rid}×{n}" for rid, n in sorted(by_rule.items()))
+    lines.append(f"graftlint: {len(findings)} finding"
+                 f"{'s' if len(findings) != 1 else ''} ({tally})")
+    return "\n".join(lines)

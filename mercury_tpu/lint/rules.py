@@ -1,0 +1,753 @@
+"""graftlint Layer 1: AST rules for JAX-hazard patterns.
+
+Pure stdlib — this module must never import jax (Layer 1 runs in CI
+before any backend exists, and on machines with no accelerator stack).
+
+Every rule is registered in :data:`RULES` with an ID (``GL1xx``), a slug,
+a one-line summary, and a fix-it hint; the catalog with examples lives in
+``docs/LINT.md``. Rules operate on a shared per-file analysis
+(:class:`ModuleAnalysis`) that computes, once:
+
+- the parent map and the enclosing function of every node;
+- import aliases for ``numpy`` / ``jax.numpy`` / ``jax.lax``;
+- the set of *traced* functions — functions whose bodies execute under a
+  jax trace, detected structurally: decorated with ``jit``-family
+  decorators, passed (possibly through ``functools.partial`` or local
+  ``name = other`` aliases) into ``jax.jit`` / ``shard_map`` /
+  ``lax.scan`` / ``lax.cond`` / ``grad`` / ``vmap`` / …, or nested inside
+  such a function (closures trace with their parent).
+
+The traced-function detection is deliberately structural rather than a
+call-graph: it has no false positives on plain host code, and the JAX
+rules (host-sync, tracer-branch, mutable-global closure) only fire inside
+functions it marks.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Rule", "RULES", "RawFinding", "ModuleAnalysis", "run_rules"]
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str          # "GL101"
+    slug: str        # "key-reuse"
+    summary: str     # one-line what/why
+    hint: str        # generic fix-it
+
+
+@dataclass(frozen=True)
+class RawFinding:
+    rule: Rule
+    line: int
+    col: int
+    message: str
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def _rule(id: str, slug: str, summary: str, hint: str) -> Rule:
+    r = Rule(id, slug, summary, hint)
+    RULES[id] = r
+    return r
+
+
+GL100 = _rule(
+    "GL100", "bad-suppression",
+    "graftlint suppression comment is malformed, names an unknown rule, "
+    "or omits the mandatory reason",
+    "write `# graftlint: disable=GL1xx -- why this is intentional`",
+)
+GL101 = _rule(
+    "GL101", "key-reuse",
+    "a PRNG key is consumed by two jax.random calls (including "
+    "split-then-reuse-parent): the two draws are correlated, not "
+    "independent",
+    "split fresh subkeys (`k1, k2 = jax.random.split(key)`) or fold_in a "
+    "distinct constant per stream; never pass an already-consumed key to "
+    "another jax.random call",
+)
+GL102 = _rule(
+    "GL102", "host-sync",
+    "host synchronization inside a traced function (`.item()`, "
+    "`np.asarray`, `jax.device_get`, `float()` on a tracer): blocks "
+    "dispatch or fails at trace time",
+    "keep device values on device inside jit; move host conversion "
+    "outside the traced function or use jnp equivalents",
+)
+GL103 = _rule(
+    "GL103", "tracer-branch",
+    "Python `if`/`assert`/`while` on a tracer-valued expression inside a "
+    "traced function: the branch is resolved once at trace time (or "
+    "raises TracerBoolConversionError)",
+    "use `lax.cond` / `jnp.where` for data-dependent control flow, or "
+    "`checkify` for runtime assertions",
+)
+GL104 = _rule(
+    "GL104", "mutable-default",
+    "mutable default argument (list/dict/set): shared across calls, and "
+    "a silent trace-time constant under jit",
+    "default to None and construct the container inside the function",
+)
+GL105 = _rule(
+    "GL105", "unordered-iter",
+    "dict/set iteration feeding array or pytree construction: the "
+    "structure (and thus the traced program) depends on insertion/hash "
+    "order",
+    "iterate `sorted(d.items())` (or a fixed key list) so the pytree "
+    "structure is deterministic",
+)
+GL106 = _rule(
+    "GL106", "use-after-donate",
+    "an argument donated via `donate_argnums` is read after the call: "
+    "its buffer may already be aliased to the output (garbage or a "
+    "deleted-array error)",
+    "rebind the donated name from the call's output "
+    "(`state, aux = step(state, ...)`) or drop the donation",
+)
+GL107 = _rule(
+    "GL107", "mutable-global",
+    "traced function reads a mutable module-level global: the value is "
+    "baked in at trace time, so later mutation is silently invisible to "
+    "the compiled program",
+    "pass the value as an argument (static or traced) or make the global "
+    "an immutable constant",
+)
+GL108 = _rule(
+    "GL108", "eager-log-format",
+    "eager f-string/.format/% formatting in a logging call: the string "
+    "is built even when the level is disabled — on a hot path that is "
+    "per-step host work for nothing",
+    "use lazy %-style args: `log.info(\"loss %.4f at %d\", loss, step)`",
+)
+
+
+# --------------------------------------------------------------------------
+# shared per-module analysis
+# --------------------------------------------------------------------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Call targets whose function-valued arguments are traced by jax. Bare
+# names (from-imports) and attribute names (jax.jit, lax.scan, ...) both
+# match on the final component.
+_TRACE_ENTRY_NAMES = {
+    "jit", "pjit", "shard_map", "vmap", "pmap", "xmap", "grad",
+    "value_and_grad", "jacfwd", "jacrev", "hessian", "linearize", "jvp",
+    "vjp", "scan", "cond", "switch", "while_loop", "fori_loop",
+    "associative_scan", "checkpoint", "remat", "custom_jvp", "custom_vjp",
+    "eval_shape", "make_jaxpr", "named_call", "defjvp", "defvjp",
+}
+
+_RANDOM_CONSUMERS = {
+    "bits", "uniform", "normal", "truncated_normal", "randint", "choice",
+    "permutation", "shuffle", "bernoulli", "categorical", "gumbel",
+    "exponential", "gamma", "beta", "dirichlet", "laplace", "logistic",
+    "poisson", "rademacher", "split", "fold_in", "ball", "cauchy",
+    "multivariate_normal", "orthogonal", "t",
+}
+
+_RANDOM_MODULE_HINTS = {"random", "jr", "jrandom", "jrand"}
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.random.split' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_attr(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+class ModuleAnalysis:
+    """One pass of shared facts rules key on (see module docstring)."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.np_aliases: Set[str] = set()
+        self.jnp_aliases: Set[str] = set()
+        self.lax_aliases: Set[str] = set()
+        self._collect_imports()
+        self.traced: Set[ast.AST] = set()
+        self._detect_traced()
+        self.mutable_globals: Dict[str, int] = {}
+        self._collect_mutable_globals()
+
+    # -------------------------------------------------------------- imports
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name
+                    if a.name == "numpy":
+                        self.np_aliases.add(name)
+                    elif a.name == "jax.numpy":
+                        self.jnp_aliases.add(name)
+                    elif a.name == "jax.lax":
+                        self.lax_aliases.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    name = a.asname or a.name
+                    if mod == "jax" and a.name == "numpy":
+                        self.jnp_aliases.add(name)
+                    elif mod == "jax" and a.name == "lax":
+                        self.lax_aliases.add(name)
+                    elif mod == "jax" and a.name == "random":
+                        pass  # handled via _RANDOM_MODULE_HINTS
+        # Conventional aliases even without an import statement in this
+        # file (a rule should not go blind because of a star import).
+        self.np_aliases.add("np")
+        self.jnp_aliases.add("jnp")
+        self.lax_aliases.add("lax")
+
+    # ------------------------------------------------------- traced funcs
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, _FUNC_NODES):
+            cur = self.parents.get(cur)
+        return cur
+
+    def _scope_of(self, node: ast.AST) -> ast.AST:
+        return self.enclosing_function(node) or self.tree
+
+    def _detect_traced(self) -> None:
+        # name -> funcdefs per defining scope, and alias edges
+        # (scope, alias) -> {source names} from `alias = source`.
+        defs: Dict[Tuple[int, str], List[ast.AST]] = {}
+        aliases: Dict[Tuple[int, str], Set[str]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_NODES):
+                scope = self._scope_of(node)
+                defs.setdefault((id(scope), node.name), []).append(node)
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Name):
+                scope = self._scope_of(node)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.setdefault(
+                            (id(scope), t.id), set()).add(node.value.id)
+
+        marked: Set[Tuple[int, str]] = set()
+
+        def mark(scope: ast.AST, name: str) -> None:
+            key = (id(scope), name)
+            if key in marked:
+                return
+            marked.add(key)
+            for src in aliases.get(key, ()):  # fn = body → body is traced
+                mark(scope, src)
+            for fn in defs.get(key, ()):
+                self.traced.add(fn)
+
+        def candidate_funcs(arg: ast.AST) -> Iterator[ast.expr]:
+            """The function-valued expressions a trace-entry arg carries
+            (unwrapping functools.partial one level)."""
+            if isinstance(arg, (ast.Name, ast.Lambda)):
+                yield arg
+            elif isinstance(arg, ast.Call) and _last_attr(
+                    arg.func) == "partial" and arg.args:
+                yield from candidate_funcs(arg.args[0])
+
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _last_attr(node.func) not in _TRACE_ENTRY_NAMES:
+                continue
+            scope = self._scope_of(node)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for fn in candidate_funcs(arg):
+                    if isinstance(fn, ast.Name):
+                        mark(scope, fn.id)
+
+        # decorators: @jax.jit, @partial(jax.jit, ...), @shard_map(...)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, _FUNC_NODES):
+                continue
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = _last_attr(target)
+                if name in _TRACE_ENTRY_NAMES:
+                    self.traced.add(node)
+                elif name == "partial" and isinstance(dec, ast.Call) \
+                        and dec.args and _last_attr(
+                            dec.args[0]) in _TRACE_ENTRY_NAMES:
+                    self.traced.add(node)
+
+        # closure: functions nested inside a traced function trace with it
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if isinstance(node, _FUNC_NODES) and node not in self.traced:
+                    enc = self.enclosing_function(node)
+                    if enc is not None and enc in self.traced:
+                        self.traced.add(node)
+                        changed = True
+
+    # -------------------------------------------------- mutable globals
+    def _collect_mutable_globals(self) -> None:
+        for stmt in self.tree.body:
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            if value is None or not _is_mutable_ctor(value):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.mutable_globals[t.id] = stmt.lineno
+
+    # ------------------------------------------------------------ helpers
+    def nodes_of_function(self, fn: ast.AST) -> Iterator[ast.AST]:
+        """Nodes whose *immediately* enclosing function is ``fn`` (nested
+        function bodies belong to their own scope)."""
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            if self.enclosing_function(node) is fn:
+                yield node
+
+    def functions(self) -> Iterator[ast.AST]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_NODES):
+                yield node
+
+    def calls_into(self, aliases: Set[str], node: ast.AST) -> bool:
+        """Does the subtree contain a call rooted at one of ``aliases``
+        (e.g. ``jnp.any(...)``)?"""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                dotted = _dotted(sub.func)
+                if dotted and dotted.split(".")[0] in aliases:
+                    return True
+        return False
+
+
+def _is_mutable_ctor(node: ast.expr) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in {"list", "dict", "set", "defaultdict",
+                                "deque", "OrderedDict", "Counter"}
+    return False
+
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+
+def _random_consume_key(node: ast.Call) -> Optional[str]:
+    """The dotted key expression a jax.random call consumes, or None."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    if func.attr not in _RANDOM_CONSUMERS:
+        return None
+    base = _dotted(func.value)
+    if base is None:
+        return None
+    parts = set(base.split("."))
+    if not (parts & _RANDOM_MODULE_HINTS):
+        return None
+    if not node.args:
+        return None
+    return _dotted(node.args[0])
+
+
+def _stores_in(node: ast.AST) -> Iterator[str]:
+    """Dotted names this statement (re)binds."""
+    targets: List[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.NamedExpr):
+        targets = [node.target]
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        targets = [node.target]
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in node.items
+                   if i.optional_vars is not None]
+    for t in targets:
+        for el in ast.walk(t):
+            name = _dotted(el)
+            if name:
+                yield name
+
+
+def check_key_reuse(an: ModuleAnalysis) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    scopes: List[ast.AST] = [an.tree] + list(an.functions())
+    for fn in scopes:
+        events: List[Tuple[Tuple[int, int, int], str, str, ast.AST]] = []
+        nodes = (an.nodes_of_function(fn) if isinstance(fn, _FUNC_NODES)
+                 else (n for n in ast.walk(fn)
+                       if an.enclosing_function(n) is None and n is not fn))
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                key = _random_consume_key(node)
+                if key:
+                    events.append(((node.lineno, node.col_offset, 0),
+                                   "consume", key, node))
+            for name in _stores_in(node):
+                end = (getattr(node, "end_lineno", node.lineno) or
+                       node.lineno)
+                endc = getattr(node, "end_col_offset", 0) or 0
+                events.append(((end, endc, 1), "store", name, node))
+        events.sort(key=lambda e: e[0])
+        live: Dict[str, ast.AST] = {}  # key name -> first consuming call
+        for _, kind, name, node in events:
+            if kind == "store":
+                live.pop(name, None)
+                continue
+            first = live.get(name)
+            if first is None:
+                live[name] = node
+            else:
+                fn_name = _last_attr(node.func) or "?"
+                out.append(RawFinding(
+                    GL101, node.lineno, node.col_offset,
+                    f"PRNG key '{name}' consumed again by jax.random."
+                    f"{fn_name} (first consumed on line "
+                    f"{first.lineno}) — the streams are correlated",
+                ))
+        del live
+    return out
+
+
+_NP_CONVERTERS = {"asarray", "array", "copyto", "save", "float32",
+                  "float64", "int32", "int64"}
+
+
+def check_host_sync(an: ModuleAnalysis) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for fn in an.traced:
+        for node in an.nodes_of_function(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = _last_attr(func)
+            if isinstance(func, ast.Attribute) and attr == "item" \
+                    and not node.args:
+                out.append(RawFinding(
+                    GL102, node.lineno, node.col_offset,
+                    ".item() inside a traced function forces a "
+                    "device→host sync (or a tracer error)",
+                ))
+                continue
+            if attr == "device_get":
+                out.append(RawFinding(
+                    GL102, node.lineno, node.col_offset,
+                    "jax.device_get inside a traced function is a host "
+                    "round-trip per call",
+                ))
+                continue
+            if isinstance(func, ast.Attribute) \
+                    and attr in _NP_CONVERTERS:
+                base = _dotted(func.value)
+                if base and base.split(".")[0] in an.np_aliases:
+                    out.append(RawFinding(
+                        GL102, node.lineno, node.col_offset,
+                        f"numpy {attr}() inside a traced function "
+                        "materializes on host (tracer error or silent "
+                        "constant-folding)",
+                    ))
+                    continue
+            if isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                          "bool") \
+                    and node.args:
+                arg = node.args[0]
+                if an.calls_into(an.jnp_aliases | an.lax_aliases, arg):
+                    out.append(RawFinding(
+                        GL102, node.lineno, node.col_offset,
+                        f"{func.id}() on a tracer-valued expression "
+                        "inside a traced function is a concretization "
+                        "error (or a hidden host sync outside jit)",
+                    ))
+    return out
+
+
+def check_tracer_branch(an: ModuleAnalysis) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    jaxy = None
+    for fn in an.traced:
+        if jaxy is None:
+            jaxy = an.jnp_aliases | an.lax_aliases
+        for node in an.nodes_of_function(fn):
+            test: Optional[ast.expr] = None
+            kind = None
+            if isinstance(node, (ast.If, ast.While)):
+                test, kind = node.test, type(node).__name__.lower()
+            elif isinstance(node, ast.Assert):
+                test, kind = node.test, "assert"
+            elif isinstance(node, ast.IfExp):
+                test, kind = node.test, "conditional expression"
+            if test is None:
+                continue
+            if an.calls_into(jaxy, test):
+                out.append(RawFinding(
+                    GL103, node.lineno, node.col_offset,
+                    f"Python {kind} on a tracer-valued expression inside "
+                    "a traced function: resolved once at trace time",
+                ))
+    return out
+
+
+def check_mutable_default(an: ModuleAnalysis) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for fn in an.functions():
+        args = fn.args
+        for default in list(args.defaults) + [
+                d for d in args.kw_defaults if d is not None]:
+            if _is_mutable_ctor(default):
+                out.append(RawFinding(
+                    GL104, default.lineno, default.col_offset,
+                    f"mutable default argument in {fn.name}(): shared "
+                    "across calls",
+                ))
+    return out
+
+
+_ARRAY_CTORS = {"stack", "concatenate", "array", "asarray", "hstack",
+                "vstack"}
+
+
+def _unordered_iterable(node: ast.expr) -> Optional[str]:
+    """'d.values()' / 'set(...)' description if ``node`` iterates in
+    dict/set order, None otherwise (sorted(...) launders it)."""
+    if isinstance(node, ast.Call):
+        attr = _last_attr(node.func)
+        if isinstance(node.func, ast.Attribute) and attr in (
+                "values", "keys", "items"):
+            base = _dotted(node.func.value) or "dict"
+            return f"{base}.{attr}()"
+        if isinstance(node.func, ast.Name) and node.func.id == "set":
+            return "set(...)"
+        if isinstance(node.func, ast.Name) and node.func.id == "list" \
+                and node.args:
+            return _unordered_iterable(node.args[0])
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set literal"
+    return None
+
+
+def check_unordered_iter(an: ModuleAnalysis) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for node in ast.walk(an.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = _last_attr(node.func)
+        if attr not in _ARRAY_CTORS:
+            continue
+        if isinstance(node.func, ast.Attribute):
+            base = _dotted(node.func.value)
+            if not base or base.split(".")[0] not in an.jnp_aliases \
+                    | an.np_aliases:
+                continue
+        else:
+            continue
+        for arg in node.args:
+            src = _unordered_iterable(arg)
+            if src is None and isinstance(
+                    arg, (ast.ListComp, ast.GeneratorExp)):
+                src = _unordered_iterable(arg.generators[0].iter)
+            if src is not None:
+                out.append(RawFinding(
+                    GL105, arg.lineno, arg.col_offset,
+                    f"{attr}() consumes {src}: array/pytree layout "
+                    "depends on dict/set iteration order",
+                ))
+    return out
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """For a ``jax.jit(..., donate_argnums=...)`` call with a constant
+    argnums, the donated positions; None if absent/non-constant."""
+    if _last_attr(call.func) not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return {e.value for e in v.elts}
+        return None
+    return None
+
+
+def check_use_after_donate(an: ModuleAnalysis) -> List[RawFinding]:
+    # name -> donated positions, for module/function-local `f = jax.jit(...,
+    # donate_argnums=...)` bindings (constant argnums only).
+    donators: Dict[str, Set[int]] = {}
+    for node in ast.walk(an.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donators[t.id] = pos
+    if not donators:
+        return []
+    out: List[RawFinding] = []
+    scopes: List[ast.AST] = [an.tree] + list(an.functions())
+    for fn in scopes:
+        nodes = (list(an.nodes_of_function(fn))
+                 if isinstance(fn, _FUNC_NODES)
+                 else [n for n in ast.walk(fn)
+                       if an.enclosing_function(n) is None and n is not fn])
+        calls = [n for n in nodes if isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Name)
+                 and n.func.id in donators]
+        for call in calls:
+            donated = {call.args[i].id for i in donators[call.func.id]
+                       if i < len(call.args)
+                       and isinstance(call.args[i], ast.Name)}
+            if not donated:
+                continue
+            # names the call's own statement rebinds (state, m = f(state))
+            stmt = call
+            while stmt in an.parents and not isinstance(
+                    stmt, ast.stmt):
+                stmt = an.parents[stmt]
+            rebound = set(_stores_in(stmt)) if isinstance(
+                stmt, ast.stmt) else set()
+            pos = (call.lineno, call.col_offset)
+            for node in nodes:
+                if not isinstance(node, ast.Name) or not isinstance(
+                        node.ctx, ast.Load):
+                    continue
+                if node.id not in donated or node.id in rebound:
+                    continue
+                if (node.lineno, node.col_offset) <= pos:
+                    continue
+                out.append(RawFinding(
+                    GL106, node.lineno, node.col_offset,
+                    f"'{node.id}' was donated to {call.func.id}() on "
+                    f"line {call.lineno} and is read afterwards: its "
+                    "buffer may be aliased away",
+                ))
+                break  # one finding per donated name per call
+    return out
+
+
+def check_mutable_global(an: ModuleAnalysis) -> List[RawFinding]:
+    if not an.mutable_globals:
+        return []
+    out: List[RawFinding] = []
+    for fn in an.traced:
+        local: Set[str] = {a.arg for a in list(fn.args.args)
+                           + list(fn.args.kwonlyargs)
+                           + list(fn.args.posonlyargs)}
+        if fn.args.vararg:
+            local.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            local.add(fn.args.kwarg.arg)
+        for node in an.nodes_of_function(fn):
+            for name in _stores_in(node):
+                local.add(name.split(".")[0])
+        seen: Set[str] = set()
+        for node in an.nodes_of_function(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load) \
+                    and node.id in an.mutable_globals \
+                    and node.id not in local and node.id not in seen:
+                seen.add(node.id)
+                out.append(RawFinding(
+                    GL107, node.lineno, node.col_offset,
+                    f"traced function reads mutable module global "
+                    f"'{node.id}' (defined line "
+                    f"{an.mutable_globals[node.id]}): its value is "
+                    "frozen into the trace",
+                ))
+    return out
+
+
+def _is_eager_format(arg: ast.expr) -> Optional[str]:
+    if isinstance(arg, ast.JoinedStr):
+        return "f-string"
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mod):
+        return "eager %-interpolation"
+    if isinstance(arg, ast.Call) and _last_attr(arg.func) == "format":
+        return ".format()"
+    return None
+
+
+def check_eager_log_format(an: ModuleAnalysis) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for node in ast.walk(an.tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute):
+            continue
+        if node.func.attr not in _LOG_METHODS:
+            continue
+        receiver = (_dotted(node.func.value) or "").lower()
+        if "log" not in receiver:
+            continue
+        idx = 1 if node.func.attr == "log" else 0
+        if len(node.args) <= idx:
+            continue
+        how = _is_eager_format(node.args[idx])
+        if how:
+            out.append(RawFinding(
+                GL108, node.lineno, node.col_offset,
+                f"{how} built eagerly in a {node.func.attr}() log call",
+            ))
+    return out
+
+
+_CHECKS = (
+    check_key_reuse,
+    check_host_sync,
+    check_tracer_branch,
+    check_mutable_default,
+    check_unordered_iter,
+    check_use_after_donate,
+    check_mutable_global,
+    check_eager_log_format,
+)
+
+
+def run_rules(tree: ast.Module,
+              select: Optional[Sequence[str]] = None) -> List[RawFinding]:
+    """All raw (pre-suppression) findings for a parsed module."""
+    an = ModuleAnalysis(tree)
+    findings: List[RawFinding] = []
+    for check in _CHECKS:
+        findings.extend(check(an))
+    if select is not None:
+        wanted = set(select)
+        findings = [f for f in findings
+                    if f.rule.id in wanted or f.rule.slug in wanted]
+    findings.sort(key=lambda f: (f.line, f.col, f.rule.id))
+    return findings

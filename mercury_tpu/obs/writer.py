@@ -31,6 +31,13 @@ import threading
 import time
 from typing import Dict, Iterable, Optional
 
+from mercury_tpu.utils.logging import get_logger
+
+# Drain-thread failures must never raise into training; they are counted
+# (``.errors``) and logged lazily — %-style args only (GL108), so a
+# disabled level costs nothing per record.
+_log = get_logger("mercury_tpu.obs.writer")
+
 
 def _to_host_record(step: int, t: float, scalars: Dict) -> Dict[str, float]:
     """device_get + reduce: each value becomes one float (scan-chunked
@@ -124,8 +131,10 @@ class AsyncMetricWriter:
             if flush is not None:
                 try:
                     flush()
-                except Exception:
+                except Exception as exc:
                     self.errors += 1
+                    _log.warning("sink %s flush failed: %s",
+                                 type(s).__name__, exc)
 
     def close(self) -> None:
         """Drain, stop the thread, close every sink. Idempotent."""
@@ -141,8 +150,10 @@ class AsyncMetricWriter:
         for s in self.sinks:
             try:
                 s.close()
-            except Exception:
+            except Exception as exc:
                 self.errors += 1
+                _log.warning("sink %s close failed: %s",
+                             type(s).__name__, exc)
 
     def __enter__(self) -> "AsyncMetricWriter":
         return self
@@ -159,14 +170,18 @@ class AsyncMetricWriter:
             record = _to_host_record(step, t, scalars)
             if self.dropped:
                 record["obs/dropped"] = float(self.dropped)
-        except Exception:
+        except Exception as exc:
             self.errors += 1
+            _log.warning("metric record for step %d failed on host "
+                         "conversion: %s", step, exc)
             return
         for s in self.sinks:
             try:
                 s.write(record)
-            except Exception:
+            except Exception as exc:
                 self.errors += 1
+                _log.warning("sink %s write failed at step %d: %s",
+                             type(s).__name__, step, exc)
 
     def _drain_pending(self) -> None:
         while True:
@@ -200,8 +215,10 @@ class AsyncMetricWriter:
                     if flush is not None:
                         try:
                             flush()
-                        except Exception:
+                        except Exception as exc:
                             self.errors += 1
+                            _log.warning("sink %s idle-flush failed: %s",
+                                         type(s).__name__, exc)
 
 
 # ------------------------------------------------------------------- sinks

@@ -95,8 +95,13 @@ def _nll_bwd_kernel(logits_ref, labels_ref, g_ref, grad_ref):
 def per_sample_nll_pallas(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Fused per-sample cross-entropy (``reduction='none'``) as a Pallas
     kernel. ``logits``: [N, C] (any float dtype), ``labels``: [N] int.
-    Returns fp32 ``[N]`` losses. Differentiable w.r.t. logits."""
-    return _nll_fwd_raw(logits, labels)
+    Returns fp32 ``[N]`` losses. Differentiable w.r.t. logits.
+
+    Runs under the ``mercury_nll_kernel`` named scope — the jaxpr auditor
+    (``mercury_tpu/lint/audit.py``) keys per-region checks on these
+    anchors when a TPU plan traces the Pallas path."""
+    with jax.named_scope("mercury_nll_kernel"):
+        return _nll_fwd_raw(logits, labels)
 
 
 def _vjp_fwd(logits, labels):
@@ -255,29 +260,32 @@ def score_and_draw_pallas(
         ])
     uniforms = jax.random.uniform(key, (1, batch_size), jnp.float32)
     kernel = functools.partial(_score_draw_kernel, alpha=alpha, true_n=n)
-    probs, selected, scaled = pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
-            jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
-            jax.ShapeDtypeStruct((1, batch_size), jnp.float32),
-        ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ),
-        interpret=_interpret(),
-    )(
-        losses.reshape(-1, 1).astype(jnp.float32),
-        ema_value.reshape(1, 1).astype(jnp.float32),
-        uniforms,
-    )
+    # Auditor anchor (see per_sample_nll_pallas): the fused selection
+    # kernel is one named region in the traced program.
+    with jax.named_scope("mercury_score_draw_kernel"):
+        probs, selected, scaled = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+                jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
+                jax.ShapeDtypeStruct((1, batch_size), jnp.float32),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            interpret=_interpret(),
+        )(
+            losses.reshape(-1, 1).astype(jnp.float32),
+            ema_value.reshape(1, 1).astype(jnp.float32),
+            uniforms,
+        )
     return probs[:n, 0], selected[0, :], scaled[0, :]
 
 

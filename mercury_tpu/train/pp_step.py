@@ -127,10 +127,13 @@ def make_pp_mercury_step(
         pool_x = x_train[slots]
         pool_y = y_train[slots]
 
-        # Score the pool through the pipeline (one schedule pass).
-        pool_out = pp_fwd(state.stacked, state.rest, pool_x)
-        pool_logits = pool_out[0] if moe else pool_out
-        pool_losses = per_sample_loss(pool_logits, pool_y)
+        # Score the pool through the pipeline (one schedule pass). The
+        # mercury_scoring scope anchors the jaxpr auditor's per-region
+        # checks (lint/audit.py).
+        with jax.named_scope("mercury_scoring"):
+            pool_out = pp_fwd(state.stacked, state.rest, pool_x)
+            pool_logits = pool_out[0] if moe else pool_out
+            pool_losses = per_sample_loss(pool_logits, pool_y)
         sel = select_from_pool(
             k_sel, pool_losses, state.ema, batch_size,
             is_alpha=is_alpha, ema_alpha=ema_alpha,

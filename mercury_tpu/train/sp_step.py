@@ -241,8 +241,11 @@ def make_dp_sp_mercury_step(
             aux = lax.pmean(sum_sowed_losses(mut), seq_axis)
             return logits, aux
 
-        pool_logits, _ = fwd(state.params, pool_x)  # scoring: aux unused
-        pool_losses = per_sample_loss(pool_logits, pool_y)
+        # mercury_scoring / mercury_grad_sync scopes anchor the jaxpr
+        # auditor's per-region collective budgets (lint/audit.py).
+        with jax.named_scope("mercury_scoring"):
+            pool_logits, _ = fwd(state.params, pool_x)  # scoring: aux unused
+            pool_losses = per_sample_loss(pool_logits, pool_y)
         sel = select_from_pool(
             k_sel, pool_losses, ema, batch_size,
             is_alpha=is_alpha, ema_alpha=ema_alpha, axis_name=data_axis,
@@ -270,11 +273,12 @@ def make_dp_sp_mercury_step(
         # (the data division is the grad MEAN over workers, ≡ the fused
         # dp step's allreduce_mean_tree). Pinned against the unsharded
         # step by TestDpSpMercuryStep.
-        grads = jax.tree.map(
-            lambda g: lax.psum(g, (data_axis, seq_axis))
-            / (axis_size(data_axis) * axis_size(seq_axis)),
-            grads,
-        )
+        with jax.named_scope("mercury_grad_sync"):
+            grads = jax.tree.map(
+                lambda g: lax.psum(g, (data_axis, seq_axis))
+                / (axis_size(data_axis) * axis_size(seq_axis)),
+                grads,
+            )
         loss = lax.pmean(loss, data_axis)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

@@ -375,7 +375,7 @@ def make_train_step(
         # fold_in (not a 9-way split) so the eight existing streams — and
         # every recorded seeded trajectory — are unchanged by the
         # compression feature's existence.
-        k_quant = jax.random.fold_in(rng, 0x71)
+        k_quant = jax.random.fold_in(rng, 0x71)  # graftlint: disable=GL101 -- deliberate sentinel stream: fold_in(rng, 0x71) is disjoint from the 8-way split, preserving recorded trajectories
 
         groupwise = None
         new_pending = None
@@ -393,29 +393,33 @@ def make_train_step(
             """Gather → augment → inference-mode scoring forward — the
             pool-scoring prologue shared by the inline, pipelined,
             cadence, and groupwise IS paths (one definition so a change
-            to scoring cannot drift between them)."""
-            raw, labs = gather_train(slots)
-            imgs = _augment(ka, normalize_images(raw, mean, std))
-            if scoring_model is None:
-                pool_logits, _, _ = _apply_train(
-                    state.params, state.batch_stats, imgs, False
+            to scoring cannot drift between them). The whole prologue
+            runs under the ``mercury_scoring`` named scope — the jaxpr
+            auditor (``mercury_tpu/lint/audit.py``) keys per-region
+            checks (e.g. bf16-scoring dot dtypes) on this anchor."""
+            with jax.named_scope("mercury_scoring"):
+                raw, labs = gather_train(slots)
+                imgs = _augment(ka, normalize_images(raw, mean, std))
+                if scoring_model is None:
+                    pool_logits, _, _ = _apply_train(
+                        state.params, state.batch_stats, imgs, False
+                    )
+                else:
+                    # Same params, lower-precision compute (scoring_dtype) —
+                    # scores only rank candidates, and the reweight divides by
+                    # the realized probs, so this stays unbiased.
+                    variables = {"params": state.params}
+                    mutable = ["losses"]
+                    if state.batch_stats:
+                        variables["batch_stats"] = state.batch_stats
+                        mutable = ["batch_stats", "losses"]
+                    pool_logits, _ = scoring_model.apply(
+                        variables, imgs, train=True, mutable=mutable
+                    )
+                    pool_logits = pool_logits.astype(jnp.float32)
+                return imgs, labs, pool_logits, _score_per_sample(
+                    pool_logits, labs
                 )
-            else:
-                # Same params, lower-precision compute (scoring_dtype) —
-                # scores only rank candidates, and the reweight divides by
-                # the realized probs, so this stays unbiased.
-                variables = {"params": state.params}
-                mutable = ["losses"]
-                if state.batch_stats:
-                    variables["batch_stats"] = state.batch_stats
-                    mutable = ["batch_stats", "losses"]
-                pool_logits, _ = scoring_model.apply(
-                    variables, imgs, train=True, mutable=mutable
-                )
-                pool_logits = pool_logits.astype(jnp.float32)
-            return imgs, labs, pool_logits, _score_per_sample(
-                pool_logits, labs
-            )
 
         if pipelined:
             # --- pipelined scoring: train on the batch selected last step,
@@ -735,13 +739,19 @@ def make_train_step(
                     compressed_psum_scatter_mean,
                 )
 
-                kz = jax.random.fold_in(rng, 0x72)
+                kz = jax.random.fold_in(rng, 0x72)  # graftlint: disable=GL101 -- deliberate sentinel stream 0x72 for int8 grad compression, disjoint from the 8-way split and 0x71
                 kz1, kz2 = jax.random.split(kz)
-                gchunk = compressed_psum_scatter_mean(
-                    pad_to_chunks(gvec, w), axis, kz1
-                )
+                # mercury_grad_sync scopes anchor the jaxpr auditor's
+                # per-region collective budgets (lint/audit.py).
+                with jax.named_scope("mercury_grad_sync"):
+                    gchunk = compressed_psum_scatter_mean(
+                        pad_to_chunks(gvec, w), axis, kz1
+                    )
             else:
-                gchunk = lax.psum_scatter(pad_to_chunks(gvec, w), axis) / w
+                with jax.named_scope("mercury_grad_sync"):
+                    gchunk = (
+                        lax.psum_scatter(pad_to_chunks(gvec, w), axis) / w
+                    )
             if telemetry:
                 # The chunks partition the full mean-gradient vector (the
                 # pad is zeros), so psum of the per-chunk square-sums is the
@@ -753,13 +763,15 @@ def make_train_step(
             pchunk = pad_to_chunks(pvec, w)[lax.axis_index(axis)]
             updates_chunk, new_opt_chunk = tx.update(gchunk, opt_chunk, pchunk)
             if int8_allreduce:
-                uvec = compressed_all_gather(updates_chunk, axis, kz2)[
-                    : gvec.size
-                ]
+                with jax.named_scope("mercury_grad_sync"):
+                    uvec = compressed_all_gather(updates_chunk, axis, kz2)[
+                        : gvec.size
+                    ]
             else:
-                uvec = lax.all_gather(
-                    updates_chunk, axis, tiled=True
-                )[: gvec.size]
+                with jax.named_scope("mercury_grad_sync"):
+                    uvec = lax.all_gather(
+                        updates_chunk, axis, tiled=True
+                    )[: gvec.size]
             new_params = optax.apply_updates(state.params, unravel(uvec))
             new_opt_state = jax.tree_util.tree_map(
                 lambda x: x[None], new_opt_chunk
@@ -776,22 +788,27 @@ def make_train_step(
                         compressed_pmean_tree_sharded,
                     )
 
-                    grads = compressed_pmean_tree_sharded(
-                        grads, axis, axis_size(axis),
-                        jax.random.fold_in(rng, 0x72),
-                        specs=sharded_param_specs,
-                    )
+                    with jax.named_scope("mercury_grad_sync"):
+                        grads = compressed_pmean_tree_sharded(
+                            grads, axis, axis_size(axis),
+                            # graftlint: disable=GL101 -- same deliberate 0x72 sentinel stream as the ZeRO branch (mutually exclusive at trace time)
+                            jax.random.fold_in(rng, 0x72),
+                            specs=sharded_param_specs,
+                        )
                 else:
                     from mercury_tpu.parallel.collectives import (
                         compressed_allreduce_mean_tree,
                     )
 
-                    grads = compressed_allreduce_mean_tree(
-                        grads, axis, axis_size(axis),
-                        jax.random.fold_in(rng, 0x72),
-                    )
+                    with jax.named_scope("mercury_grad_sync"):
+                        grads = compressed_allreduce_mean_tree(
+                            grads, axis, axis_size(axis),
+                            # graftlint: disable=GL101 -- same deliberate 0x72 sentinel stream as the ZeRO branch (mutually exclusive at trace time)
+                            jax.random.fold_in(rng, 0x72),
+                        )
             else:
-                grads = allreduce_mean_tree(grads, axis)
+                with jax.named_scope("mercury_grad_sync"):
+                    grads = allreduce_mean_tree(grads, axis)
             if telemetry:
                 # Post-allreduce: already the worker-mean gradient, so the
                 # norm is identical on every worker (replicated output).

@@ -53,6 +53,9 @@ from mercury_tpu.parallel.mesh import make_mesh
 from mercury_tpu.train import checkpoint as ckpt
 from mercury_tpu.train.state import MercuryState, create_state, make_optimizer
 from mercury_tpu.train.step import make_eval_epoch, make_eval_step, make_train_step
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.train.trainer")
 
 
 def build_dataset(config: TrainConfig, seed_offset: int = 0) -> ShardedDataset:
@@ -492,9 +495,11 @@ class Trainer:
                     # The probe's raw tree feeds the restore — the file is
                     # deserialized once on this (elastic) branch.
                     resumed = self.restore_elastic(step=raw_step, raw=raw)
-                    print(f"auto-resumed elastically from a {w_ckpt}-worker "
-                          f"checkpoint at step {resumed} "
-                          f"(now {config.world_size} workers)")
+                    _log.info(
+                        "auto-resumed elastically from a %d-worker "
+                        "checkpoint at step %d (now %d workers)",
+                        w_ckpt, resumed, config.world_size,
+                    )
                 else:
                     # Same topology (the common case): the probe's tree is
                     # not a substitute for restore()'s corrupt-fallback
@@ -502,7 +507,8 @@ class Trainer:
                     # than holding two copies of a possibly-large state.
                     del raw
                     resumed = self.restore()
-                    print(f"auto-resumed from checkpoint at step {resumed}")
+                    _log.info("auto-resumed from checkpoint at step %d",
+                              resumed)
                 self._auto_resumed = True
 
     # ------------------------------------------------------------------ fit

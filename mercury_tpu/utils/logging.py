@@ -17,9 +17,31 @@ scripts and as the drop-in minimal logger.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Dict, Optional
+
+
+def get_logger(name: str = "mercury_tpu") -> logging.Logger:
+    """The package's stdlib logger, configured once.
+
+    Call sites must use lazy %-style arguments
+    (``log.info("resumed at %d", step)``), never f-strings — graftlint's
+    GL108 rule enforces this so disabled-level log calls on hot paths
+    cost a no-op instead of string formatting.
+    """
+    logger = logging.getLogger(name)
+    root = logging.getLogger("mercury_tpu")
+    if not root.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+    return logger
 
 
 def _try_tensorboard_writer(log_dir: str):

@@ -1,0 +1,445 @@
+"""graftlint: Layer 1 rule fixtures (positive + negative per rule),
+suppression parsing, and Layer 2 budget verification — including a
+deliberately corrupted budget and a deliberately changed config, both of
+which must fail with a readable diff."""
+
+import json
+import textwrap
+
+import pytest
+
+from mercury_tpu.lint import RULES, format_findings, lint_paths, lint_source
+
+
+def ids(src, **kw):
+    return [f.rule_id for f in lint_source(textwrap.dedent(src), **kw)]
+
+
+class TestKeyReuse:
+    def test_double_consume_fires(self):
+        assert ids("""
+            import jax
+            def f(k):
+                a = jax.random.normal(k)
+                b = jax.random.uniform(k)
+                return a + b
+        """) == ["GL101"]
+
+    def test_split_then_reuse_parent_fires(self):
+        assert ids("""
+            import jax
+            def f(key):
+                k1, k2 = jax.random.split(key)
+                x = jax.random.normal(key)
+                return k1, k2, x
+        """) == ["GL101"]
+
+    def test_fresh_subkeys_clean(self):
+        assert ids("""
+            import jax
+            def f(key):
+                k1, k2 = jax.random.split(key)
+                return jax.random.normal(k1) + jax.random.uniform(k2)
+        """) == []
+
+    def test_rebind_resets_liveness(self):
+        # `key, sub = split(key)` consumes then REBINDS key — using the
+        # new key afterwards is the canonical idiom, not reuse.
+        assert ids("""
+            import jax
+            def f(key):
+                key, sub = jax.random.split(key)
+                a = jax.random.normal(sub)
+                key, sub = jax.random.split(key)
+                return a + jax.random.normal(sub)
+        """) == []
+
+    def test_separate_functions_do_not_alias(self):
+        assert ids("""
+            import jax
+            def f(k):
+                return jax.random.normal(k)
+            def g(k):
+                return jax.random.normal(k)
+        """) == []
+
+
+class TestHostSync:
+    def test_item_in_jitted_fires(self):
+        assert ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                return x.item()
+        """) == ["GL102"]
+
+    def test_np_asarray_in_traced_fires(self):
+        assert ids("""
+            import jax
+            import numpy as np
+            def body(x):
+                return np.asarray(x)
+            out = jax.jit(body)
+        """) == ["GL102"]
+
+    def test_float_on_tracer_expr_fires(self):
+        assert ids("""
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def f(x):
+                return float(jnp.sum(x))
+        """) == ["GL102"]
+
+    def test_float_on_static_value_clean(self):
+        # Trace-time constant (sizes): exactly the step.py
+        # `float(sum(g.size for g in leaves))` pattern — must NOT fire.
+        assert ids("""
+            import jax
+            @jax.jit
+            def f(tree):
+                leaves = jax.tree_util.tree_leaves(tree)
+                total = float(sum(g.size for g in leaves))
+                return total
+        """) == []
+
+    def test_item_outside_traced_clean(self):
+        assert ids("""
+            def report(x):
+                return x.item()
+        """) == []
+
+    def test_alias_propagation_marks_body(self):
+        # `fn = body` then shard_map(fn, ...): body is traced.
+        assert ids("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+            def make(mesh):
+                def body(x):
+                    return jax.device_get(x)
+                fn = body
+                return shard_map(fn, mesh=mesh, in_specs=None,
+                                 out_specs=None)
+        """) == ["GL102"]
+
+
+class TestTracerBranch:
+    def test_if_on_jnp_fires(self):
+        assert ids("""
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def f(x):
+                if jnp.any(x > 0):
+                    return x
+                return -x
+        """) == ["GL103"]
+
+    def test_assert_on_jnp_fires(self):
+        assert ids("""
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def f(x):
+                assert jnp.all(x > 0)
+                return x
+        """) == ["GL103"]
+
+    def test_static_shape_check_clean(self):
+        # sp_step.py's `if t % w_seq != 0: raise` — static, must not fire.
+        assert ids("""
+            import jax
+            @jax.jit
+            def f(x, w):
+                if x.shape[0] % 4 != 0:
+                    raise ValueError("bad shape")
+                return x
+        """) == []
+
+
+class TestMutableDefault:
+    def test_list_default_fires(self):
+        assert ids("def f(x, acc=[]):\n    return acc\n") == ["GL104"]
+
+    def test_dict_call_default_fires(self):
+        assert ids("def f(x, opts=dict()):\n    return opts\n") == ["GL104"]
+
+    def test_none_default_clean(self):
+        assert ids("def f(x, acc=None):\n    return acc\n") == []
+
+
+class TestUnorderedIter:
+    def test_stack_over_dict_values_fires(self):
+        assert ids("""
+            import jax.numpy as jnp
+            def f(d):
+                return jnp.stack([v for v in d.values()])
+        """) == ["GL105"]
+
+    def test_stack_over_sorted_items_clean(self):
+        assert ids("""
+            import jax.numpy as jnp
+            def f(d):
+                return jnp.stack([v for _, v in sorted(d.items())])
+        """) == []
+
+
+class TestUseAfterDonate:
+    def test_read_after_donated_call_fires(self):
+        assert ids("""
+            import jax
+            step = jax.jit(lambda s, x: s, donate_argnums=0)
+            def loop(state, x):
+                out = step(state, x)
+                return state.params, out
+        """) == ["GL106"]
+
+    def test_rebound_from_output_clean(self):
+        assert ids("""
+            import jax
+            step = jax.jit(lambda s, x: s, donate_argnums=0)
+            def loop(state, x):
+                state = step(state, x)
+                return state
+        """) == []
+
+
+class TestMutableGlobal:
+    def test_traced_read_of_mutable_global_fires(self):
+        assert ids("""
+            import jax
+            SCALES = {"a": 1.0}
+            @jax.jit
+            def f(x):
+                return x * SCALES["a"]
+        """) == ["GL107"]
+
+    def test_untraced_read_clean(self):
+        assert ids("""
+            SCALES = {"a": 1.0}
+            def f(x):
+                return x * SCALES["a"]
+        """) == []
+
+    def test_immutable_global_clean(self):
+        assert ids("""
+            import jax
+            SCALE = 2.0
+            @jax.jit
+            def f(x):
+                return x * SCALE
+        """) == []
+
+
+class TestEagerLogFormat:
+    def test_fstring_in_log_call_fires(self):
+        assert ids("""
+            import logging
+            log = logging.getLogger(__name__)
+            def f(step, loss):
+                log.info(f"loss {loss} at {step}")
+        """) == ["GL108"]
+
+    def test_lazy_percent_style_clean(self):
+        assert ids("""
+            import logging
+            log = logging.getLogger(__name__)
+            def f(step, loss):
+                log.info("loss %.4f at %d", loss, step)
+        """) == []
+
+    def test_non_logger_receiver_clean(self):
+        assert ids("""
+            def f(printer, x):
+                printer.info(f"value {x}")
+        """) == []
+
+
+class TestSuppressions:
+    SRC = """
+        import jax
+        def f(k):
+            a = jax.random.normal(k)
+            b = jax.random.uniform(k)  # graftlint: disable=GL101 -- fixture: correlated draws wanted
+            return a + b
+    """
+
+    def test_inline_suppression_with_reason(self):
+        assert ids(self.SRC) == []
+
+    def test_missing_reason_is_gl100_and_does_not_suppress(self):
+        src = self.SRC.replace(" -- fixture: correlated draws wanted", "")
+        assert sorted(ids(src)) == ["GL100", "GL101"]
+
+    def test_unknown_rule_is_gl100(self):
+        src = self.SRC.replace("GL101", "GL999X")
+        assert sorted(ids(src)) == ["GL100", "GL101"]
+
+    def test_standalone_comment_covers_next_line(self):
+        assert ids("""
+            import jax
+            def f(k):
+                a = jax.random.normal(k)
+                # graftlint: disable=key-reuse -- fixture: slug spelling
+                b = jax.random.uniform(k)
+                return a + b
+        """) == []
+
+    def test_file_wide_suppression(self):
+        assert ids("""
+            # graftlint: disable-file=GL104 -- fixture: test corpus
+            def f(x, acc=[]):
+                return acc
+            def g(x, acc=[]):
+                return acc
+        """) == []
+
+    def test_suppression_is_rule_scoped(self):
+        # A GL104 suppression must not hide a GL101 on the same line.
+        assert ids("""
+            import jax
+            def f(k):
+                a = jax.random.normal(k)
+                b = jax.random.uniform(k)  # graftlint: disable=GL104 -- fixture: wrong rule
+                return a + b
+        """) == ["GL101"]
+
+
+class TestEngine:
+    def test_package_is_lint_clean(self):
+        import mercury_tpu
+
+        pkg_dir = mercury_tpu.__path__[0]
+        findings = lint_paths([pkg_dir])
+        assert findings == [], format_findings(findings)
+
+    def test_syntax_error_reported_not_raised(self):
+        fs = lint_source("def f(:\n")
+        assert [f.rule_id for f in fs] == ["GL999"]
+
+    def test_select_filters_rules(self):
+        src = """
+            import jax
+            def f(k, acc=[]):
+                a = jax.random.normal(k)
+                b = jax.random.uniform(k)
+                return a + b
+        """
+        assert ids(src, select=["GL104"]) == ["GL104"]
+        assert ids(src, select=["key-reuse"]) == ["GL101"]
+
+    def test_every_rule_has_catalog_fields(self):
+        for rule in RULES.values():
+            assert rule.id.startswith("GL")
+            assert rule.slug and rule.summary and rule.hint
+
+    def test_format_findings_tally(self):
+        out = format_findings(lint_source(
+            "def f(a=[], b={}):\n    return a, b\n", path="x.py"))
+        assert "x.py:1:" in out and "GL104×2" in out
+
+
+# ---------------------------------------------------------------- Layer 2
+
+class TestAuditBudgets:
+    """Budget comparison logic on a once-measured dp plan (one trace,
+    class-scoped); corruption must fail with a readable diff."""
+
+    @pytest.fixture(scope="class")
+    def dp(self):
+        from mercury_tpu.lint import audit
+
+        return audit.measure_plan("dp")
+
+    def test_dp_invariants_hold(self, dp):
+        from mercury_tpu.lint import audit
+
+        assert audit.check_invariants(dp) == []
+        assert dp.host_callbacks == 0
+        assert set(dp.metric_keys) == audit.SEED_METRIC_KEYS
+
+    def test_dp_matches_committed_budget(self, dp):
+        from mercury_tpu.lint import audit
+
+        budgets = audit.load_budgets()
+        errors, warnings = audit.compare_budgets([dp], budgets)
+        if budgets["provenance"]["jax"] == _jax_version():
+            assert errors == [], "\n".join(errors)
+        else:  # foreign jax: mismatches demote to warnings by design
+            assert errors == [], "\n".join(errors)
+            assert warnings
+
+    def test_corrupted_budget_fails_with_readable_diff(self, dp):
+        from mercury_tpu.lint import audit
+
+        budgets = json.loads(json.dumps(audit.load_budgets()))
+        budgets["provenance"]["jax"] = _jax_version()  # force hard mode
+        plan = budgets["plans"]["dp"]
+        plan["collectives"]["psum"] = plan["collectives"].get("psum", 0) + 1
+        errors, _ = audit.compare_budgets([dp], budgets)
+        diff = "\n".join(errors)
+        assert "plan dp" in diff
+        assert "psum expected" in diff and "-1" in diff
+        assert "--regen" in diff or "regenerate" in diff
+
+    def test_corrupted_digest_fails(self, dp):
+        from mercury_tpu.lint import audit
+
+        budgets = json.loads(json.dumps(audit.load_budgets()))
+        budgets["provenance"]["jax"] = _jax_version()
+        budgets["plans"]["dp"]["jaxpr_sha256"] = "0" * 64
+        errors, _ = audit.compare_budgets([dp], budgets)
+        assert any("jaxpr_sha256" in e for e in errors)
+
+    def test_foreign_jax_version_demotes_to_warnings(self, dp):
+        from mercury_tpu.lint import audit
+
+        budgets = json.loads(json.dumps(audit.load_budgets()))
+        budgets["provenance"]["jax"] = "0.0.0-not-this"
+        budgets["plans"]["dp"]["jaxpr_sha256"] = "0" * 64
+        errors, warnings = audit.compare_budgets([dp], budgets)
+        assert errors == []
+        assert any("jaxpr_sha256" in w for w in warnings)
+
+    def test_callback_invariant_catches_telemetry_leak(self, dp):
+        from mercury_tpu.lint import audit
+
+        broken = json.loads(json.dumps(dp.as_budget()))
+        m = audit.PlanMeasurement(plan="dp", config=broken["config"])
+        m.metric_keys = dp.metric_keys
+        m.host_callbacks = 2
+        errors = audit.check_invariants(m)
+        assert any("host callback" in e for e in errors)
+
+
+@pytest.mark.slow
+class TestAuditMatrix:
+    """Full parallelism-plan matrix vs committed budgets (tracing sp/pp
+    transformers is compile-free but still seconds each — slow tier)."""
+
+    def test_all_plans_verify(self):
+        from mercury_tpu.lint import audit
+
+        errors, warnings = audit.run_audit()
+        assert errors == [], "\n".join(errors + warnings)
+
+    def test_changed_config_breaks_budget(self):
+        """A deliberately changed config (ZeRO toggled on under the dp
+        plan's name) must trip the dp collective budget."""
+        from mercury_tpu.lint import audit
+
+        step, args, config = audit._BUILDERS["zero"]()
+        imposter = audit.measure_step(step, args, "dp", config)
+        budgets = json.loads(json.dumps(audit.load_budgets()))
+        budgets["provenance"]["jax"] = _jax_version()
+        errors, _ = audit.compare_budgets([imposter], budgets)
+        diff = "\n".join(errors)
+        assert "plan dp" in diff
+        assert "reduce_scatter" in diff or "all_gather" in diff \
+            or "psum" in diff
+
+
+def _jax_version() -> str:
+    import jax
+
+    return jax.__version__
