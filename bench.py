@@ -433,7 +433,14 @@ def main():
                 f"result from {cached.get('timestamp', 'unknown')}"
             )
             record = cached
-            print("# emitting cached last-good real-chip result (stale)",
+            # Persist the stale mark so bench_last_good.json itself says
+            # the cached number no longer reflects a live measurement —
+            # a later reader of the cache file sees the same flag the
+            # emitted record carries.
+            _save_last_good(cached)
+            print("# WARNING: backend unreachable — emitting cached "
+                  "last-good real-chip result (stale=true, from "
+                  f"{cached.get('timestamp', 'unknown')})",
                   file=sys.stderr)
 
     if record is None:

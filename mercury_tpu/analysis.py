@@ -48,7 +48,8 @@ __all__ = [
 ]
 
 
-def collective_footprint(fn, *args) -> dict:
+def collective_footprint(fn, *args, plan: str = "adhoc",
+                         telemetry: bool = False) -> dict:
     """Structural footprint of the program ``fn(*args)`` traces: exact
     per-primitive collective counts (global and per ``mercury_*`` named
     scope), host-callback count, and the canonicalized jaxpr digest.
@@ -64,17 +65,36 @@ def collective_footprint(fn, *args) -> dict:
                                   ds.shard_indices)
         fp["collectives"]          # {"psum": 26, ...}
         fp["host_callbacks"]       # 0 unless telemetry streams callbacks
-    """
-    from mercury_tpu.lint.audit import measure_step
 
-    m = measure_step(fn, args, plan="adhoc", config={})
+    ``plan`` labels the measurement (and must be one of the auditor's
+    plan names or ``"adhoc"`` — a typo here would silently mislabel a
+    record someone later diffs against ``lint/budgets.json``, so unknown
+    names raise). ``telemetry`` declares whether the step is EXPECTED to
+    stream host callbacks: with ``telemetry=False`` any callback found
+    is listed in ``fp["callback_violations"]`` — the silent-sync smell
+    the auditor pins to zero in CI.
+    """
+    from mercury_tpu.lint.audit import PLAN_NAMES, measure_step
+
+    known = PLAN_NAMES + ("adhoc",)
+    if plan not in known:
+        raise ValueError(
+            f"unknown plan {plan!r} (known: {', '.join(known)})")
+    m = measure_step(fn, args, plan=plan, config={})
+    violations = []
+    if not telemetry and m.host_callbacks:
+        violations.append(
+            f"{m.host_callbacks} host callback(s) in a telemetry=False "
+            f"step — each is a device→host sync on the hot path")
     return {
+        "plan": plan,
         "collectives": dict(sorted(m.collectives.items())),
         "scoped_collectives": {
             k: dict(sorted(v.items()))
             for k, v in m.scoped_collectives.items()
         },
         "host_callbacks": m.host_callbacks,
+        "callback_violations": violations,
         "donation_markers": m.donation_markers,
         "jaxpr_sha256": m.jaxpr_sha256,
         "metric_keys": m.metric_keys,

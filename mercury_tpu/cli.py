@@ -118,6 +118,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             fp = collective_footprint(
                 trainer.train_step, trainer.state, trainer._step_x,
                 trainer._step_y, trainer.dataset.shard_indices,
+                telemetry=config.telemetry,
             )
             print(json.dumps(fp, indent=2))
             return 0

@@ -23,6 +23,22 @@ Two layers, one entry point (``python -m mercury_tpu.lint``):
   the committed ``lint/budgets.json`` golden file (regenerate with
   ``--regen``), so program drift is a reviewed diff, not a surprise.
 
+- **Layer 3** (:mod:`mercury_tpu.lint.sharding`,
+  :mod:`mercury_tpu.lint.memory`) AOT-lowers AND COMPILES every plan on
+  the CPU mesh and audits the post-SPMD program: compiled collective
+  counts attributed to the ``mercury_scoring`` / ``mercury_grad_sync``
+  named scopes via HLO ``op_name`` metadata (no implicit resharding
+  outside them), ``with_sharding_constraint`` coverage for every >1 MiB
+  intermediate produced in ``parallel/{fsdp,tensor,sequence,pipeline}``
+  GSPMD-auto regions, a monotone per-plan peak-buffer ratchet from
+  ``compiled.memory_analysis()`` (±25% CPU-estimate tolerance), and a
+  dataflow f32→bf16-scoring leak check (operand-origin walk, not just
+  dot ops as in Layer 2). Goldens live in ``lint/shard_budgets.json``
+  (``--layer sharding --regen``). New AST rules GL110–GL113 ride along
+  in Layer 1 (unconstrained pjit output, bare ``device_put`` in hot
+  modules, manual ``all_gather`` in auto regions, mesh-axis literals
+  off the ``parallel/mesh.py`` registry).
+
 See ``docs/LINT.md`` for the rule catalog and ``docs/DESIGN.md`` for the
 audit invariants.
 """

@@ -9,7 +9,18 @@ Layer selection:
 - ``--layer audit``: Layer 2 — trace the parallelism-plan matrix on CPU
   and verify against the committed ``lint/budgets.json`` (``--regen`` to
   re-record it after an intentional program change).
-- ``--layer all``: both.
+- ``--layer sharding``: Layer 3 — AOT-lower + compile each plan on the
+  CPU mesh and verify the sharding/memory invariants against the
+  committed ``lint/shard_budgets.json`` (``--regen`` parity).
+- ``--layer all``: all three. With ``--diff-out PATH`` the audit diff
+  goes to ``PATH`` and the sharding diff to ``PATH.sharding``.
+
+``--json`` emits one document for every layer that ran::
+
+    {"schema": "graftlint_findings_v2",
+     "findings": [{"layer": "ast", "rule_id": ..., ...},
+                  {"layer": "sharding", "severity": "error",
+                   "message": ...}, ...]}
 """
 
 from __future__ import annotations
@@ -20,6 +31,10 @@ import os
 import sys
 from typing import List, Optional
 
+#: Version tag for the ``--json`` document; bump when the finding shape
+#: changes (v2 added the envelope + per-finding ``layer``).
+JSON_SCHEMA = "graftlint_findings_v2"
+
 
 def _package_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -29,12 +44,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mercury_tpu.lint",
         description="graftlint: JAX-hazard AST linter (Layer 1) + "
-                    "jaxpr/HLO structural auditor (Layer 2)",
+                    "jaxpr/HLO structural auditor (Layer 2) + "
+                    "sharding & memory auditor (Layer 3)",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/directories for Layer 1 (default: the "
                          "mercury_tpu package)")
-    ap.add_argument("--layer", choices=("ast", "audit", "all"),
+    ap.add_argument("--layer", choices=("ast", "audit", "sharding", "all"),
                     default="ast")
     ap.add_argument("--select", action="append", default=None,
                     metavar="RULE",
@@ -43,18 +59,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--list-rules", action="store_true",
                     help="print the Layer 1 rule catalog and exit")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable findings")
+                    help="machine-readable findings (one document for "
+                         "all layers run)")
     ap.add_argument("--plans", default=None,
-                    help="comma-separated audit plans "
+                    help="comma-separated audit/sharding plans "
                          "(default: dp,zero,dp_bf16,sp,pp)")
     ap.add_argument("--budgets", default=None, metavar="PATH",
-                    help="budgets.json to verify against / regenerate")
+                    help="Layer 2 budgets.json to verify against / "
+                         "regenerate")
+    ap.add_argument("--shard-budgets", default=None, metavar="PATH",
+                    help="Layer 3 shard_budgets.json to verify against "
+                         "/ regenerate")
     ap.add_argument("--regen", action="store_true",
-                    help="re-measure and WRITE budgets.json instead of "
-                         "verifying (review the diff before committing)")
+                    help="re-measure and WRITE the budget file(s) instead "
+                         "of verifying (review the diff before committing)")
     ap.add_argument("--diff-out", default=None, metavar="PATH",
-                    help="write the audit diff to this file on mismatch "
-                         "(CI artifact)")
+                    help="write the budget diff to this file on mismatch "
+                         "(CI artifact; with --layer all the sharding "
+                         "diff goes to PATH.sharding)")
     args = ap.parse_args(argv)
 
     from mercury_tpu.lint.rules import RULES
@@ -66,28 +88,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     rc = 0
+    json_findings: List[dict] = []
+
+    def collect(layer: str, errors: List[str], warnings: List[str]) -> None:
+        for line in warnings:
+            json_findings.append(
+                {"layer": layer, "severity": "warning", "message": line})
+        for line in errors:
+            json_findings.append(
+                {"layer": layer, "severity": "error", "message": line})
+
     if args.layer in ("ast", "all"):
         from mercury_tpu.lint.engine import format_findings, lint_paths
 
         paths = args.paths or [_package_root()]
         findings = lint_paths(paths, select=args.select)
         if args.as_json:
-            print(json.dumps([f.__dict__ for f in findings], indent=2))
+            json_findings.extend(
+                {"layer": "ast", "severity": "error", **f.__dict__}
+                for f in findings)
         else:
             print(format_findings(findings))
         if findings:
             rc = 1
 
+    def _resolve_plans(known, what):
+        plans = (tuple(p.strip() for p in args.plans.split(","))
+                 if args.plans else known)
+        unknown = [p for p in plans if p not in known]
+        if unknown:
+            print(f"unknown {what} plan(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(known)})", file=sys.stderr)
+            return None
+        return plans
+
     if args.layer in ("audit", "all"):
         from mercury_tpu.lint import audit
 
-        plans = (tuple(p.strip() for p in args.plans.split(","))
-                 if args.plans else audit.PLAN_NAMES)
-        unknown = [p for p in plans if p not in audit.PLAN_NAMES]
-        if unknown:
-            print(f"unknown audit plan(s): {', '.join(unknown)} "
-                  f"(known: {', '.join(audit.PLAN_NAMES)})",
-                  file=sys.stderr)
+        plans = _resolve_plans(audit.PLAN_NAMES, "audit")
+        if plans is None:
             return 2
         try:
             errors, warnings = audit.run_audit(
@@ -97,15 +136,53 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"graftlint audit: budgets file missing ({exc}) — "
                   "run with --regen first", file=sys.stderr)
             return 2
-        for line in warnings:
-            print(f"warning: {line}")
-        for line in errors:
-            print(line)
+        if args.as_json:
+            collect("audit", errors, warnings)
+        else:
+            for line in warnings:
+                print(f"warning: {line}")
+            for line in errors:
+                print(line)
+            if not errors:
+                print(f"graftlint audit: {len(plans)} plan(s) verified "
+                      f"({', '.join(plans)})")
         if errors:
             rc = 1
+
+    if args.layer in ("sharding", "all"):
+        from mercury_tpu.lint import sharding
+
+        plans = _resolve_plans(sharding.PLAN_NAMES, "sharding")
+        if plans is None:
+            return 2
+        diff_out = args.diff_out
+        if diff_out and args.layer == "all":
+            diff_out = diff_out + ".sharding"
+        try:
+            errors, warnings = sharding.run_sharding_audit(
+                plans=plans, budgets_path=args.shard_budgets,
+                regen=args.regen, diff_out=diff_out)
+        except FileNotFoundError as exc:
+            print(f"graftlint sharding: budgets file missing ({exc}) — "
+                  "run with --layer sharding --regen first",
+                  file=sys.stderr)
+            return 2
+        if args.as_json:
+            collect("sharding", errors, warnings)
         else:
-            print(f"graftlint audit: {len(plans)} plan(s) verified "
-                  f"({', '.join(plans)})")
+            for line in warnings:
+                print(f"warning: {line}")
+            for line in errors:
+                print(line)
+            if not errors:
+                print(f"graftlint sharding: {len(plans)} plan(s) "
+                      f"verified ({', '.join(plans)})")
+        if errors:
+            rc = 1
+
+    if args.as_json:
+        print(json.dumps(
+            {"schema": JSON_SCHEMA, "findings": json_findings}, indent=2))
 
     return rc
 

@@ -142,7 +142,7 @@ def lint_source(source: str, path: str = "<string>",
                         exc.lineno or 0, (exc.offset or 1) - 1,
                         f"file does not parse: {exc.msg}", gl100.hint)]
     sup = _parse_suppressions(source)
-    raw = run_rules(tree, select=select)
+    raw = run_rules(tree, select=select, path=path)
     for f in raw:
         if f.rule.id in sup.file_wide:
             continue

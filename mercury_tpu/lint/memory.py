@@ -1,0 +1,254 @@
+"""graftlint Layer 3, memory half: compiled-memory profiles and
+constraint-coverage of large intermediates.
+
+Two measurements feed the sharding auditor (:mod:`mercury_tpu.lint.
+sharding`):
+
+- **Compiled memory profile** — :func:`memory_profile` reads
+  ``compiled.memory_analysis()`` (XLA's ``CompiledMemoryStats``) into a
+  plain dict of byte counts plus a derived ``peak_estimate_in_bytes``.
+  The committed per-plan values act as a *monotone ratchet*: a measured
+  profile may not exceed the recorded one by more than
+  :data:`DEFAULT_TOLERANCE`. The tolerance exists because the numbers
+  come from the **CPU** backend standing in for TPU — buffer assignment
+  differs across backends and XLA releases, so the budget catches
+  regressions of the "accidentally materialized the gathered score
+  table" magnitude (x2..xW), not byte-exact layout shifts. Shrinking
+  past the tolerance is a *warning* nudging a ``--regen`` so the
+  ratchet tightens.
+- **Constraint coverage** — :func:`unconstrained_large_intermediates`
+  walks a traced jaxpr and reports every intermediate larger than
+  :data:`MIN_CONSTRAINED_BYTES` whose producing equation lives in one of
+  the GSPMD-partitioned ``parallel/`` modules but is neither produced by
+  nor consumed by a ``sharding_constraint``. Interiors of ``shard_map``
+  are exempt: they are manual SPMD — GSPMD propagation never sees them,
+  so a constraint there would be meaningless.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+MIB = 1024 ** 2
+
+#: >1 MiB intermediates in GSPMD-auto regions must carry an explicit
+#: with_sharding_constraint (ISSUE 4 invariant).
+MIN_CONSTRAINED_BYTES = MIB
+
+#: CPU-estimate tolerance for the per-plan memory ratchet (see module
+#: docstring): measured ≤ recorded × (1 + tol) or the audit fails.
+DEFAULT_TOLERANCE = 0.25
+
+#: The GSPMD-partitioned modules whose large intermediates must be
+#: explicitly constrained. shard_map-interior code (sequence/pipeline
+#: bodies) is exempted by context, not by path.
+HOT_PARALLEL_MODULES = (
+    "parallel/fsdp.py",
+    "parallel/tensor.py",
+    "parallel/sequence.py",
+    "parallel/pipeline.py",
+)
+
+#: CompiledMemoryStats fields recorded per plan.
+MEMORY_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+#: Fields the ratchet compares (generated code size is recorded for
+#: provenance but too noisy across XLA builds to gate on).
+COMPARED_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "peak_estimate_in_bytes",
+)
+
+
+def format_bytes(n: int) -> str:
+    """'3.2 MiB' — human-readable byte counts for diff messages."""
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(value) < 1024.0 or unit == "GiB":
+            return (f"{int(value)} {unit}" if unit == "B"
+                    else f"{value:.1f} {unit}")
+        value /= 1024.0
+    return f"{n} B"
+
+
+def memory_profile(compiled) -> Dict[str, int]:
+    """``compiled.memory_analysis()`` as a plain dict of byte counts.
+
+    Returns ``{}`` when the backend provides no memory analysis (older
+    jaxlib / exotic backends) — the caller skips the memory checks then.
+    """
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if stats is None:
+        return {}
+    out: Dict[str, int] = {}
+    for name in MEMORY_FIELDS:
+        value = getattr(stats, name, None)
+        if value is not None:
+            out[name] = int(value)
+    if {"argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes"} <= out.keys():
+        # Live-at-peak upper bound: args + outputs + temps, minus buffers
+        # aliased away by donation.
+        out["peak_estimate_in_bytes"] = (
+            out["argument_size_in_bytes"] + out["output_size_in_bytes"]
+            + out["temp_size_in_bytes"]
+            - out.get("alias_size_in_bytes", 0))
+    return out
+
+
+def compare_memory(plan: str, recorded: Dict[str, int],
+                   measured: Dict[str, int],
+                   tolerance: float = DEFAULT_TOLERANCE,
+                   ) -> Tuple[List[str], List[str]]:
+    """Monotone ratchet: ``(errors, warnings)`` against the committed
+    per-plan profile. Growth past ``tolerance`` is an error; shrinking
+    past it is a warning (regenerate so the ratchet tightens)."""
+    errors: List[str] = []
+    warnings: List[str] = []
+    if not recorded or not measured:
+        return errors, warnings
+    for name in COMPARED_FIELDS:
+        want, got = recorded.get(name), measured.get(name)
+        if want is None or got is None:
+            continue
+        if got > want * (1.0 + tolerance):
+            errors.append(
+                f"  memory[{name}]: {format_bytes(got)} exceeds budget "
+                f"{format_bytes(want)} by more than the {tolerance:.0%} "
+                "CPU-estimate tolerance — a buffer got bigger")
+        elif want and got < want * (1.0 - tolerance):
+            warnings.append(
+                f"  memory[{name}]: {format_bytes(got)} is under budget "
+                f"{format_bytes(want)} by more than {tolerance:.0%} — "
+                "regenerate to ratchet the budget down")
+    return errors, warnings
+
+
+# --------------------------------------------------------------------------
+# constraint coverage of large intermediates
+# --------------------------------------------------------------------------
+
+def _sub_jaxprs(params: Dict[str, Any]) -> Iterator[Any]:
+    for value in params.values():
+        values = value if isinstance(value, (list, tuple)) else (value,)
+        for v in values:
+            if hasattr(v, "eqns"):           # Jaxpr
+                yield v
+            elif hasattr(v, "jaxpr"):        # ClosedJaxpr
+                yield v.jaxpr
+
+
+def iter_eqns_with_context(jaxpr, manual: bool = False,
+                           ) -> Iterator[Tuple[Any, bool]]:
+    """``(eqn, in_manual_region)`` pairs for every equation, recursing
+    into sub-jaxprs. ``in_manual_region`` is True inside any ``shard_map``
+    body (including partial-manual ones) — GSPMD does not propagate
+    shardings there."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn, manual
+        sub_manual = manual or eqn.primitive.name == "shard_map"
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns_with_context(sub, sub_manual)
+
+
+def user_frame(eqn) -> Optional[Tuple[str, int]]:
+    """``(file_name, line)`` of the first non-jax frame in the equation's
+    traceback, or None. jax-internal frames (site-packages, the jax tree
+    itself) lead the raw traceback and are skipped."""
+    si = getattr(eqn, "source_info", None)
+    tb = getattr(si, "traceback", None)
+    frames = getattr(tb, "frames", None)
+    if not frames:
+        return None
+    for frame in frames:
+        fname = getattr(frame, "file_name", "") or ""
+        norm = fname.replace(os.sep, "/")
+        if "site-packages" in norm or "/jax/" in norm \
+                or norm.endswith("/jax") or not norm:
+            continue
+        line = getattr(frame, "start_line",
+                       getattr(frame, "line_num", 0)) or 0
+        return fname, int(line)
+    return None
+
+
+def _aval_bytes(var) -> int:
+    aval = getattr(var, "aval", None)
+    size = getattr(aval, "size", None)
+    dtype = getattr(aval, "dtype", None)
+    if size is None or dtype is None:
+        return 0
+    try:
+        return int(size) * int(dtype.itemsize)
+    except Exception:
+        return 0
+
+
+def unconstrained_large_intermediates(
+    closed,
+    modules: Sequence[str] = HOT_PARALLEL_MODULES,
+    min_bytes: int = MIN_CONSTRAINED_BYTES,
+) -> List[str]:
+    """Messages for every >``min_bytes`` intermediate produced in one of
+    ``modules`` (path-suffix match on the producing frame) inside a
+    GSPMD-auto region that neither is, nor directly feeds, a
+    ``sharding_constraint`` equation."""
+    norm_modules = tuple(m.replace(os.sep, "/") for m in modules)
+
+    constrained: set = set()          # vars covered by a constraint
+    candidates: List[Tuple[Any, str, int, int]] = []
+    for eqn, manual in iter_eqns_with_context(closed):
+        name = eqn.primitive.name
+        if name == "sharding_constraint":
+            for v in list(eqn.invars) + list(eqn.outvars):
+                if hasattr(v, "count"):   # Var (Literals are unhashable)
+                    constrained.add(v)
+            continue
+        if manual:
+            continue
+        # Structural/no-compute primitives never materialize a new buffer
+        # worth constraining on their own.
+        if name in ("pjit", "closed_call", "custom_vjp_call",
+                    "custom_jvp_call", "scan", "while", "cond",
+                    "shard_map", "broadcast_in_dim", "squeeze",
+                    "reshape", "convert_element_type", "transpose"):
+            continue
+        frame = user_frame(eqn)
+        if frame is None:
+            continue
+        fname = frame[0].replace(os.sep, "/")
+        if not any(fname.endswith(m) for m in norm_modules):
+            continue
+        for v in eqn.outvars:
+            nbytes = _aval_bytes(v)
+            if nbytes >= min_bytes:
+                candidates.append((v, fname, frame[1], nbytes))
+                break  # one report per equation
+
+    out: List[str] = []
+    for v, fname, line, nbytes in candidates:
+        if v in constrained:
+            continue
+        aval = v.aval
+        eqn_desc = f"{aval.dtype}{list(aval.shape)}"
+        short = "/".join(fname.split("/")[-2:])
+        out.append(
+            f"{short}:{line}: {eqn_desc} intermediate "
+            f"({format_bytes(nbytes)}) in a GSPMD-auto region has no "
+            "with_sharding_constraint — its layout is whatever "
+            "propagation picks")
+    return out

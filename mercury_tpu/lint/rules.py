@@ -125,6 +125,44 @@ GL108 = _rule(
     "per-step host work for nothing",
     "use lazy %-style args: `log.info(\"loss %.4f at %d\", loss, step)`",
 )
+GL110 = _rule(
+    "GL110", "unconstrained-jit-output",
+    "jax.jit/pjit pins in_shardings but not out_shardings: the output "
+    "layout is whatever GSPMD propagation picks, which can silently "
+    "gather a sharded result back to one layout per release",
+    "pin out_shardings alongside in_shardings (or drop both and commit "
+    "layouts on the arrays)",
+)
+GL111 = _rule(
+    "GL111", "unsharded-device-put",
+    "jax.device_put without an explicit sharding in a hot module: the "
+    "array lands wherever the default device points, and the first "
+    "computation touching it pays a silent reshard",
+    "pass the target placement: "
+    "`jax.device_put(x, NamedSharding(mesh, spec))`",
+)
+GL112 = _rule(
+    "GL112", "manual-all-gather",
+    "lax.all_gather in jit-traced (non-shard_map) code: under GSPMD a "
+    "with_sharding_constraint expresses the same layout change and lets "
+    "XLA schedule/fuse the collective instead of pinning it",
+    "replace with `jax.lax.with_sharding_constraint(x, sharding)`, or "
+    "move the call inside a shard_map where manual collectives belong",
+)
+GL113 = _rule(
+    "GL113", "unknown-mesh-axis",
+    "mesh-axis name literal not in the canonical registry "
+    "(parallel/mesh.py MESH_AXES): a typo here shards nothing and fails "
+    "only at mesh-binding time, far from the mistake",
+    "use a canonical axis name (data/model/seq/pipe) or register the "
+    "new axis in parallel/mesh.py MESH_AXES",
+)
+
+# Mirror of parallel/mesh.py::MESH_AXES. Layer 1 must not import jax (or
+# anything that does), so the set is duplicated here; Layer 3's audit
+# cross-checks the two at every run (lint/sharding.py
+# check_axis_registry), so drift cannot persist.
+_MESH_AXES = ("data", "model", "seq", "pipe")
 
 
 # --------------------------------------------------------------------------
@@ -143,6 +181,11 @@ _TRACE_ENTRY_NAMES = {
     "associative_scan", "checkpoint", "remat", "custom_jvp", "custom_vjp",
     "eval_shape", "make_jaxpr", "named_call", "defjvp", "defvjp",
 }
+
+# The subset of trace entries whose bodies run in MANUAL SPMD — named
+# mesh axes are bound and hand-written collectives are the idiom there
+# (GL112 exempts these).
+_MANUAL_ENTRY_NAMES = {"shard_map", "pmap", "xmap"}
 
 _RANDOM_CONSUMERS = {
     "bits", "uniform", "normal", "truncated_normal", "randint", "choice",
@@ -181,8 +224,9 @@ def _last_attr(func: ast.AST) -> Optional[str]:
 class ModuleAnalysis:
     """One pass of shared facts rules key on (see module docstring)."""
 
-    def __init__(self, tree: ast.Module) -> None:
+    def __init__(self, tree: ast.Module, path: str = "<string>") -> None:
         self.tree = tree
+        self.path = path
         self.parents: Dict[ast.AST, ast.AST] = {}
         for node in ast.walk(tree):
             for child in ast.iter_child_nodes(node):
@@ -192,6 +236,7 @@ class ModuleAnalysis:
         self.lax_aliases: Set[str] = set()
         self._collect_imports()
         self.traced: Set[ast.AST] = set()
+        self.manual: Set[ast.AST] = set()
         self._detect_traced()
         self.mutable_globals: Dict[str, int] = {}
         self._collect_mutable_globals()
@@ -251,17 +296,23 @@ class ModuleAnalysis:
                         aliases.setdefault(
                             (id(scope), t.id), set()).add(node.value.id)
 
-        marked: Set[Tuple[int, str]] = set()
+        def make_marker(target: Set[ast.AST]):
+            seen: Set[Tuple[int, str]] = set()
 
-        def mark(scope: ast.AST, name: str) -> None:
-            key = (id(scope), name)
-            if key in marked:
-                return
-            marked.add(key)
-            for src in aliases.get(key, ()):  # fn = body → body is traced
-                mark(scope, src)
-            for fn in defs.get(key, ()):
-                self.traced.add(fn)
+            def mark(scope: ast.AST, name: str) -> None:
+                key = (id(scope), name)
+                if key in seen:
+                    return
+                seen.add(key)
+                for src in aliases.get(key, ()):  # fn = body → body too
+                    mark(scope, src)
+                for fn in defs.get(key, ()):
+                    target.add(fn)
+
+            return mark
+
+        mark = make_marker(self.traced)
+        mark_manual = make_marker(self.manual)
 
         def candidate_funcs(arg: ast.AST) -> Iterator[ast.expr]:
             """The function-valued expressions a trace-entry arg carries
@@ -275,13 +326,16 @@ class ModuleAnalysis:
         for node in ast.walk(self.tree):
             if not isinstance(node, ast.Call):
                 continue
-            if _last_attr(node.func) not in _TRACE_ENTRY_NAMES:
+            entry = _last_attr(node.func)
+            if entry not in _TRACE_ENTRY_NAMES:
                 continue
             scope = self._scope_of(node)
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 for fn in candidate_funcs(arg):
                     if isinstance(fn, ast.Name):
                         mark(scope, fn.id)
+                        if entry in _MANUAL_ENTRY_NAMES:
+                            mark_manual(scope, fn.id)
 
         # decorators: @jax.jit, @partial(jax.jit, ...), @shard_map(...)
         for node in ast.walk(self.tree):
@@ -290,23 +344,31 @@ class ModuleAnalysis:
             for dec in node.decorator_list:
                 target = dec.func if isinstance(dec, ast.Call) else dec
                 name = _last_attr(target)
+                if name == "partial" and isinstance(dec, ast.Call) \
+                        and dec.args:
+                    name = _last_attr(dec.args[0])
                 if name in _TRACE_ENTRY_NAMES:
                     self.traced.add(node)
-                elif name == "partial" and isinstance(dec, ast.Call) \
-                        and dec.args and _last_attr(
-                            dec.args[0]) in _TRACE_ENTRY_NAMES:
-                    self.traced.add(node)
+                    if name in _MANUAL_ENTRY_NAMES:
+                        self.manual.add(node)
 
-        # closure: functions nested inside a traced function trace with it
+        # closure: functions nested inside a traced (manual) function
+        # trace (run manually) with it
         changed = True
         while changed:
             changed = False
             for node in ast.walk(self.tree):
-                if isinstance(node, _FUNC_NODES) and node not in self.traced:
-                    enc = self.enclosing_function(node)
-                    if enc is not None and enc in self.traced:
-                        self.traced.add(node)
-                        changed = True
+                if not isinstance(node, _FUNC_NODES):
+                    continue
+                enc = self.enclosing_function(node)
+                if enc is None:
+                    continue
+                if enc in self.traced and node not in self.traced:
+                    self.traced.add(node)
+                    changed = True
+                if enc in self.manual and node not in self.manual:
+                    self.manual.add(node)
+                    changed = True
 
     # -------------------------------------------------- mutable globals
     def _collect_mutable_globals(self) -> None:
@@ -726,6 +788,155 @@ def check_eager_log_format(an: ModuleAnalysis) -> List[RawFinding]:
     return out
 
 
+def check_unconstrained_jit_output(an: ModuleAnalysis) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for node in ast.walk(an.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_attr(node.func) not in ("jit", "pjit"):
+            continue
+        kws = {kw.arg for kw in node.keywords if kw.arg}
+        if "in_shardings" in kws and "out_shardings" not in kws:
+            out.append(RawFinding(
+                GL110, node.lineno, node.col_offset,
+                "jit call pins in_shardings but leaves out_shardings to "
+                "GSPMD: the output layout is propagation's choice",
+            ))
+    return out
+
+
+# Modules whose device_put placements are per-step costs: a bare
+# device_put there puts an implicit reshard on the hot path. "<string>"
+# counts as hot so the rule is unit-testable through lint_source.
+_HOT_DIRS = ("parallel", "train", "sampling", "ops")
+
+
+def _in_hot_module(path: str) -> bool:
+    if path == "<string>":
+        return True
+    parts = path.replace("\\", "/").split("/")
+    return any(d in parts[:-1] for d in _HOT_DIRS)
+
+
+def check_unsharded_device_put(an: ModuleAnalysis) -> List[RawFinding]:
+    if not _in_hot_module(an.path):
+        return []
+    out: List[RawFinding] = []
+    for node in ast.walk(an.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_attr(node.func) != "device_put":
+            continue
+        has_placement = len(node.args) >= 2 or any(
+            kw.arg == "device" for kw in node.keywords)
+        if not has_placement:
+            out.append(RawFinding(
+                GL111, node.lineno, node.col_offset,
+                "device_put without an explicit sharding in a hot "
+                "module: placement falls to the default device",
+            ))
+    return out
+
+
+def check_manual_all_gather(an: ModuleAnalysis) -> List[RawFinding]:
+    out: List[RawFinding] = []
+    for fn in an.traced:
+        if fn in an.manual:
+            continue
+        for node in an.nodes_of_function(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _last_attr(node.func) != "all_gather":
+                continue
+            base = _dotted(node.func)
+            if base and base.split(".")[0] not in (
+                    an.lax_aliases | {"jax"}):
+                continue
+            out.append(RawFinding(
+                GL112, node.lineno, node.col_offset,
+                "lax.all_gather in jit-traced (non-shard_map) code: a "
+                "with_sharding_constraint expresses the same layout and "
+                "lets XLA schedule the collective",
+            ))
+    return out
+
+
+# Keyword names that carry mesh-axis names as strings, and the positional
+# slot of the axis-name argument in lax collectives.
+_AXIS_KWARG_NAMES = {
+    "axis_name", "data_axis", "model_axis", "seq_axis", "pipe_axis",
+    "sp_axis", "moe_ep_axis", "ep_axis", "mesh_axis", "stat_axis",
+}
+_AXIS_ARG_POSITIONS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "all_to_all": 1, "pbroadcast": 1, "psum_scatter": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+
+def _axis_literals(node: ast.expr) -> Iterator[ast.Constant]:
+    """String constants in an axis-naming expression (a literal or a
+    tuple/list of literals)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            yield from _axis_literals(el)
+
+
+def check_unknown_mesh_axis(an: ModuleAnalysis) -> List[RawFinding]:
+    suspects: List[ast.Constant] = []
+    for node in ast.walk(an.tree):
+        if isinstance(node, _FUNC_NODES):
+            # `def f(..., axis: str = "data")`: axis-named params' string
+            # defaults are axis names.
+            args = node.args
+            named = list(args.posonlyargs) + list(args.args)
+            defaults = list(args.defaults)
+            for arg, default in zip(named[len(named) - len(defaults):],
+                                    defaults):
+                if (arg.arg in _AXIS_KWARG_NAMES or arg.arg == "axis") \
+                        and isinstance(default, ast.Constant) \
+                        and isinstance(default.value, str):
+                    suspects.append(default)
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None \
+                        and (arg.arg in _AXIS_KWARG_NAMES
+                             or arg.arg == "axis") \
+                        and isinstance(default, ast.Constant) \
+                        and isinstance(default.value, str):
+                    suspects.append(default)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = _last_attr(node.func)
+        # P("data") / PartitionSpec("data", None)
+        if name in ("P", "PartitionSpec"):
+            for arg in node.args:
+                suspects.extend(_axis_literals(arg))
+        # Mesh(devices, ("data", "model"))
+        if name == "Mesh" and len(node.args) >= 2:
+            suspects.extend(_axis_literals(node.args[1]))
+        # lax.psum(x, "data"), lax.axis_index("data"), ...
+        pos = _AXIS_ARG_POSITIONS.get(name)
+        if pos is not None and len(node.args) > pos:
+            suspects.extend(_axis_literals(node.args[pos]))
+        # axis_name= / data_axis= / ... kwargs anywhere
+        for kw in node.keywords:
+            if kw.arg in _AXIS_KWARG_NAMES:
+                suspects.extend(_axis_literals(kw.value))
+    out: List[RawFinding] = []
+    for lit in suspects:
+        if lit.value not in _MESH_AXES:
+            out.append(RawFinding(
+                GL113, lit.lineno, lit.col_offset,
+                f"mesh-axis literal {lit.value!r} is not in the "
+                f"canonical registry {_MESH_AXES} "
+                "(parallel/mesh.py MESH_AXES)",
+            ))
+    return out
+
+
 _CHECKS = (
     check_key_reuse,
     check_host_sync,
@@ -735,13 +946,19 @@ _CHECKS = (
     check_use_after_donate,
     check_mutable_global,
     check_eager_log_format,
+    check_unconstrained_jit_output,
+    check_unsharded_device_put,
+    check_manual_all_gather,
+    check_unknown_mesh_axis,
 )
 
 
 def run_rules(tree: ast.Module,
-              select: Optional[Sequence[str]] = None) -> List[RawFinding]:
-    """All raw (pre-suppression) findings for a parsed module."""
-    an = ModuleAnalysis(tree)
+              select: Optional[Sequence[str]] = None,
+              path: str = "<string>") -> List[RawFinding]:
+    """All raw (pre-suppression) findings for a parsed module. ``path``
+    scopes the path-sensitive rules (GL111 fires in hot modules only)."""
+    an = ModuleAnalysis(tree, path=path)
     findings: List[RawFinding] = []
     for check in _CHECKS:
         findings.extend(check(an))

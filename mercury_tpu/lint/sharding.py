@@ -1,0 +1,445 @@
+"""graftlint Layer 3: sharding & memory auditor over the compiled plans.
+
+Layer 2 (:mod:`mercury_tpu.lint.audit`) pins the *traced* program; this
+layer AOT-**compiles** each parallelism plan (dp / zero / dp_bf16 / sp /
+pp) on the CPU mesh and pins what XLA actually scheduled:
+
+- **No implicit resharding.** Trace-level collectives whose name stack
+  carries neither ``mercury_scoring`` nor ``mercury_grad_sync`` are
+  counted per primitive, and the post-optimization HLO's collective ops
+  (``all-reduce``/``all-gather``/``reduce-scatter``/``collective-permute``/
+  ``all-to-all``) are counted per op and attributed to the named scopes
+  via their preserved ``op_name`` metadata. Growth in the *unscoped*
+  compiled counts is exactly a GSPMD resharding nobody asked for — the
+  silent all-gather of a score table or ZeRO shard that erases the
+  paper's scoring-FLOPs advantage.
+- **Constraint coverage.** Every >1 MiB intermediate produced by the
+  GSPMD-partitioned ``parallel/{fsdp,tensor,sequence,pipeline}.py``
+  modules must be covered by an explicit ``with_sharding_constraint``
+  (:func:`mercury_tpu.lint.memory.unconstrained_large_intermediates`;
+  ``shard_map`` interiors are manual SPMD and exempt).
+- **Monotone memory.** ``compiled.memory_analysis()`` byte counts per
+  plan, ratcheted within a documented CPU-estimate tolerance
+  (:data:`mercury_tpu.lint.memory.DEFAULT_TOLERANCE`).
+- **bf16 scoring dataflow.** For plans that declare
+  ``scoring_dtype="bfloat16"``, *no* f32 operand may reach a dot/conv
+  inside the ``mercury_scoring`` scope — a dataflow analysis that walks
+  each offending f32 value back through elementwise/convert chains to
+  name the equation where f32 entered (strictly stronger than Layer 2's
+  all-operands-f32 dot check, which a mixed bf16×f32 promotion slips
+  past).
+- **Axis-registry drift.** The AST rule GL113's hard-coded axis list
+  (Layer 1 cannot import jax) must equal
+  ``parallel/mesh.py::MESH_AXES``.
+
+Budgets live in the committed ``lint/shard_budgets.json``; regenerate
+with ``python -m mercury_tpu.lint --layer sharding --regen`` after an
+intentional change. As in Layer 2, count/memory mismatches under a
+*different* jax version than the budgets were recorded with demote to
+warnings; the hard invariants (f32 leaks, unconstrained intermediates)
+always fail loudly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from mercury_tpu.lint import memory as lint_memory
+from mercury_tpu.lint.audit import (
+    COLLECTIVE_PRIMS,
+    PLAN_NAMES,
+    SCOPES,
+    _BUILDERS,
+    _name_stack,
+    ensure_cpu_devices,
+)
+from mercury_tpu.lint.memory import iter_eqns_with_context, user_frame
+
+SCHEMA = "graftlint_shard_budgets_v1"
+
+#: Post-optimization HLO collective ops (the `-start` suffix covers the
+#: async-pair form some passes emit).
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s+\S+\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\(")
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+
+#: Elementwise / layout primitives the f32-origin walk looks *through*:
+#: they propagate an existing f32 value rather than create one.
+_F32_PASSTHROUGH = frozenset({
+    "add", "sub", "mul", "div", "max", "min", "neg", "exp", "log",
+    "tanh", "logistic", "rsqrt", "sqrt", "abs", "sign", "select_n",
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+    "dynamic_slice", "concatenate", "pad", "rev", "gather",
+    "stop_gradient", "copy", "pjit",
+})
+
+
+def default_shard_budgets_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "shard_budgets.json")
+
+
+# --------------------------------------------------------------------------
+# measurement
+# --------------------------------------------------------------------------
+
+@dataclass
+class ShardMeasurement:
+    plan: str
+    config: Dict[str, Any]
+    #: trace-level collective prims OUTSIDE both mercury scopes
+    unscoped_trace_collectives: Dict[str, int] = field(default_factory=dict)
+    #: sharding_constraint equations in the traced program
+    sharding_constraints: int = 0
+    #: compiled-HLO collective ops, total / per named scope / unscoped
+    hlo_collectives: Dict[str, int] = field(default_factory=dict)
+    hlo_scoped_collectives: Dict[str, Dict[str, int]] = field(
+        default_factory=dict)
+    hlo_unscoped_collectives: Dict[str, int] = field(default_factory=dict)
+    #: compiled.memory_analysis() byte counts (lint/memory.py)
+    memory: Dict[str, int] = field(default_factory=dict)
+    #: hard-invariant violation messages (empty on a healthy plan)
+    f32_scoring_leaks: List[str] = field(default_factory=list)
+    unconstrained_intermediates: List[str] = field(default_factory=list)
+
+    def config_hash(self) -> str:
+        blob = json.dumps(self.config, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def as_budget(self) -> Dict[str, Any]:
+        return {
+            "config_hash": self.config_hash(),
+            "config": self.config,
+            "unscoped_trace_collectives": dict(
+                sorted(self.unscoped_trace_collectives.items())),
+            "sharding_constraints": self.sharding_constraints,
+            "hlo_collectives": dict(sorted(self.hlo_collectives.items())),
+            "hlo_scoped_collectives": {
+                scope: dict(sorted(counts.items()))
+                for scope, counts in sorted(
+                    self.hlo_scoped_collectives.items())
+            },
+            "hlo_unscoped_collectives": dict(
+                sorted(self.hlo_unscoped_collectives.items())),
+            "memory": dict(sorted(self.memory.items())),
+            "f32_scoring_leaks": len(self.f32_scoring_leaks),
+            "unconstrained_intermediates":
+                len(self.unconstrained_intermediates),
+        }
+
+
+def _count_hlo_collectives(hlo_text: str) -> Tuple[
+        Dict[str, int], Dict[str, Dict[str, int]], Dict[str, int]]:
+    """``(total, per_scope, unscoped)`` collective-op counts from
+    post-optimization HLO. Scope attribution rides the ``op_name``
+    metadata XLA preserves from jax named scopes."""
+    total: Dict[str, int] = {}
+    per_scope: Dict[str, Dict[str, int]] = {s: {} for s in SCOPES}
+    unscoped: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        op = m.group(1)
+        total[op] = total.get(op, 0) + 1
+        om = _OP_NAME_RE.search(line)
+        op_name = om.group(1) if om else ""
+        hit = False
+        for scope in SCOPES:
+            if scope in op_name:
+                sc = per_scope[scope]
+                sc[op] = sc.get(op, 0) + 1
+                hit = True
+        if not hit:
+            unscoped[op] = unscoped.get(op, 0) + 1
+    return total, per_scope, unscoped
+
+
+def _is_f32(var) -> bool:
+    aval = getattr(var, "aval", None)
+    return str(getattr(aval, "dtype", "")) == "float32"
+
+
+def _f32_origin(var, producers: Dict[Any, Any], max_hops: int = 64) -> str:
+    """Walk ``var`` back through its producer chain to the equation where
+    f32 first appears (no f32 among that equation's inputs), for a
+    readable leak message."""
+    cur = var
+    for _ in range(max_hops):
+        eqn = producers.get(cur)
+        if eqn is None:
+            return "a function input / constant that is already f32"
+        f32_ins = [v for v in eqn.invars
+                   if hasattr(v, "count") and _is_f32(v)]
+        if not f32_ins or eqn.primitive.name not in _F32_PASSTHROUGH:
+            frame = user_frame(eqn)
+            where = ""
+            if frame:
+                short = "/".join(
+                    frame[0].replace(os.sep, "/").split("/")[-2:])
+                where = f" at {short}:{frame[1]}"
+            return f"f32 enters via `{eqn.primitive.name}`{where}"
+        cur = f32_ins[0]
+    return "an f32 chain deeper than the walk limit"
+
+
+def f32_scoring_leaks(closed, plan: str = "?") -> List[str]:
+    """Dataflow dtype check for bf16 scoring: one message per f32 operand
+    reaching a dot/conv inside the ``mercury_scoring`` scope."""
+    producers: Dict[Any, Any] = {}
+    scoring_compute: List[Any] = []
+    for eqn, _ in iter_eqns_with_context(closed):
+        for v in eqn.outvars:
+            if hasattr(v, "count"):
+                producers[v] = eqn
+        if eqn.primitive.name in ("dot_general", "conv_general_dilated") \
+                and "mercury_scoring" in _name_stack(eqn):
+            scoring_compute.append(eqn)
+
+    leaks: List[str] = []
+    for eqn in scoring_compute:
+        for v in eqn.invars:
+            if not _is_f32(v):
+                continue
+            aval = getattr(v, "aval", None)
+            shape = list(getattr(aval, "shape", ()))
+            origin = (_f32_origin(v, producers)
+                      if hasattr(v, "count")
+                      else "an f32 literal")
+            leaks.append(
+                f"plan {plan}: f32{shape} operand reaches "
+                f"{eqn.primitive.name} inside mercury_scoring — {origin} "
+                "(bf16 scoring region; the upcast erases the scoring "
+                "FLOP savings)")
+    return leaks
+
+
+def measure_shard_step(step_fn, args: Tuple, plan: str,
+                       config: Dict[str, Any]) -> ShardMeasurement:
+    """Trace *and compile* ``step_fn(*args)`` (AOT, no execution) and
+    collect the Layer 3 facts."""
+    import jax
+
+    m = ShardMeasurement(plan=plan, config=config)
+
+    closed = jax.make_jaxpr(step_fn)(*args)
+    for eqn, _ in iter_eqns_with_context(closed):
+        name = eqn.primitive.name
+        if name == "sharding_constraint":
+            m.sharding_constraints += 1
+        elif name in COLLECTIVE_PRIMS:
+            stack = _name_stack(eqn)
+            if not any(scope in stack for scope in SCOPES):
+                m.unscoped_trace_collectives[name] = \
+                    m.unscoped_trace_collectives.get(name, 0) + 1
+    if str(config.get("scoring_dtype", "")) == "bfloat16":
+        m.f32_scoring_leaks = f32_scoring_leaks(closed, plan)
+    m.unconstrained_intermediates = \
+        lint_memory.unconstrained_large_intermediates(closed)
+
+    lower_fn = step_fn if hasattr(step_fn, "lower") else jax.jit(step_fn)
+    compiled = lower_fn.lower(*args).compile()
+    hlo_text = compiled.as_text()
+    (m.hlo_collectives, m.hlo_scoped_collectives,
+     m.hlo_unscoped_collectives) = _count_hlo_collectives(hlo_text)
+    m.memory = lint_memory.memory_profile(compiled)
+    return m
+
+
+def measure_shard_plan(plan: str) -> ShardMeasurement:
+    step, args, config = _BUILDERS[plan]()
+    return measure_shard_step(step, args, plan, config)
+
+
+# --------------------------------------------------------------------------
+# hard invariants (budgets-file independent)
+# --------------------------------------------------------------------------
+
+def check_shard_invariants(m: ShardMeasurement) -> List[str]:
+    errors: List[str] = []
+    for leak in m.f32_scoring_leaks:
+        errors.append(leak)
+    for msg in m.unconstrained_intermediates:
+        errors.append(f"plan {m.plan}: {msg}")
+    return errors
+
+
+def check_axis_registry() -> List[str]:
+    """GL113's stdlib-side axis list must equal parallel/mesh.py's
+    canonical MESH_AXES (Layer 1 cannot import jax to read it, so Layer 3
+    owns the anti-drift check)."""
+    from mercury_tpu.lint.rules import _MESH_AXES
+    from mercury_tpu.parallel.mesh import MESH_AXES
+
+    if tuple(_MESH_AXES) != tuple(MESH_AXES):
+        return [
+            f"axis-registry drift: lint/rules.py _MESH_AXES "
+            f"{tuple(_MESH_AXES)} != parallel/mesh.py MESH_AXES "
+            f"{tuple(MESH_AXES)} — update the rules.py mirror (GL113 "
+            "would enforce a stale axis set)"]
+    return []
+
+
+# --------------------------------------------------------------------------
+# budgets file
+# --------------------------------------------------------------------------
+
+def write_shard_budgets(measurements: Sequence[ShardMeasurement],
+                        path: Optional[str] = None) -> str:
+    import jax
+    import jaxlib
+
+    path = path or default_shard_budgets_path()
+    doc = {
+        "schema": SCHEMA,
+        "provenance": {
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "python": ".".join(map(str, sys.version_info[:3])),
+            "memory_tolerance": lint_memory.DEFAULT_TOLERANCE,
+            "regenerate_with":
+                "python -m mercury_tpu.lint --layer sharding --regen",
+        },
+        "plans": {m.plan: m.as_budget() for m in measurements},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_shard_budgets(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or default_shard_budgets_path()
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path}: schema {doc.get('schema')!r}, expected {SCHEMA!r} "
+            "— regenerate with --layer sharding --regen")
+    return doc
+
+
+def _diff_counts(what: str, expected: Dict[str, int],
+                 got: Dict[str, int]) -> List[str]:
+    lines = []
+    for key in sorted(set(expected) | set(got)):
+        e, g = expected.get(key, 0), got.get(key, 0)
+        if e != g:
+            lines.append(f"  {what}: {key} expected {e}, got {g} "
+                         f"({g - e:+d})")
+    return lines
+
+
+def compare_shard_budgets(measurements: Sequence[ShardMeasurement],
+                          budgets: Dict[str, Any],
+                          ) -> Tuple[List[str], List[str]]:
+    """Diff measurements against the committed shard budgets; same
+    error/warning split as Layer 2 (foreign jax version demotes count and
+    memory diffs — HLO scheduling drifts across releases — while the hard
+    invariants always stay errors)."""
+    import jax
+
+    errors: List[str] = []
+    warnings: List[str] = []
+    provenance = budgets.get("provenance", {})
+    recorded_jax = provenance.get("jax")
+    tolerance = float(provenance.get(
+        "memory_tolerance", lint_memory.DEFAULT_TOLERANCE))
+    version_match = recorded_jax == jax.__version__
+    if not version_match:
+        warnings.append(
+            f"shard budgets recorded under jax {recorded_jax}, running "
+            f"{jax.__version__}: collective/memory diffs demoted to "
+            "warnings — regenerate shard_budgets.json on the pinned "
+            "version")
+
+    plans = budgets.get("plans", {})
+    for m in measurements:
+        errors.extend(check_shard_invariants(m))
+        budget = plans.get(m.plan)
+        if budget is None:
+            errors.append(f"plan {m.plan}: no committed shard budget — "
+                          "run --layer sharding --regen and review the "
+                          "diff")
+            continue
+        soft: List[str] = []
+        if budget.get("config_hash") != m.config_hash():
+            soft.append(
+                f"  config_hash expected {budget.get('config_hash')}, "
+                f"got {m.config_hash()} (the audited config changed — "
+                "every downstream diff follows from this)")
+        soft.extend(_diff_counts(
+            "unscoped_trace_collectives",
+            budget.get("unscoped_trace_collectives", {}),
+            m.unscoped_trace_collectives))
+        if budget.get("sharding_constraints", 0) != m.sharding_constraints:
+            e = budget.get("sharding_constraints", 0)
+            g = m.sharding_constraints
+            soft.append(
+                f"  sharding_constraints expected {e}, got {g} "
+                f"({g - e:+d})"
+                + (" — a with_sharding_constraint was dropped; the "
+                   "layout it pinned is now GSPMD's choice"
+                   if g < e else ""))
+        soft.extend(_diff_counts("hlo_collectives",
+                                 budget.get("hlo_collectives", {}),
+                                 m.hlo_collectives))
+        for scope in SCOPES:
+            soft.extend(_diff_counts(
+                f"hlo_scoped_collectives[{scope}]",
+                budget.get("hlo_scoped_collectives", {}).get(scope, {}),
+                m.hlo_scoped_collectives.get(scope, {})))
+        unscoped_diff = _diff_counts(
+            "hlo_unscoped_collectives",
+            budget.get("hlo_unscoped_collectives", {}),
+            m.hlo_unscoped_collectives)
+        for line in unscoped_diff:
+            soft.append(line + "  <- implicit resharding outside the "
+                               "mercury scopes")
+        mem_errors, mem_warnings = lint_memory.compare_memory(
+            m.plan, budget.get("memory", {}), m.memory, tolerance)
+        soft.extend(mem_errors)
+        warnings.extend(f"plan {m.plan}:{w}" for w in mem_warnings)
+        if soft:
+            header = (f"plan {m.plan}: compiled program deviates from "
+                      "committed shard budget:")
+            block = [header] + soft + [
+                "  (intentional change? regenerate: python -m "
+                "mercury_tpu.lint --layer sharding --regen)"]
+            (errors if version_match else warnings).extend(block)
+    return errors, warnings
+
+
+def run_sharding_audit(plans: Sequence[str] = PLAN_NAMES,
+                       budgets_path: Optional[str] = None,
+                       regen: bool = False,
+                       diff_out: Optional[str] = None,
+                       ) -> Tuple[List[str], List[str]]:
+    """Measure the requested plans' compiled programs and either record
+    (``regen=True``) or verify them against the committed shard budgets.
+    Returns ``(errors, warnings)``; empty errors means the audit
+    passed."""
+    ensure_cpu_devices()
+    errors: List[str] = list(check_axis_registry())
+    measurements = [measure_shard_plan(p) for p in plans]
+    if regen:
+        path = write_shard_budgets(measurements, budgets_path)
+        for m in measurements:
+            errors.extend(check_shard_invariants(m))
+        return errors, [f"shard budgets written to {path}"]
+    budgets = load_shard_budgets(budgets_path)
+    cmp_errors, warnings = compare_shard_budgets(measurements, budgets)
+    errors.extend(cmp_errors)
+    if diff_out and (errors or warnings):
+        with open(diff_out, "w") as f:
+            f.write("\n".join(
+                ["# graftlint sharding diff"] + errors +
+                ["# warnings"] + warnings) + "\n")
+    return errors, warnings

@@ -140,6 +140,7 @@ class TransformerClassifier(nn.Module):
     # identical to the dense forward. Explicit `name=` keeps the param tree
     # identical to the original compact layout.
     @nn.nowrap
+    # graftlint: disable=GL113 -- "inherit" is a copy-self.sp_axis sentinel, not an axis name
     def make_block(self, name=None, sp_axis="inherit") -> TransformerBlock:
         """The single source of truth for block construction — used by
         ``setup`` and by the pipeline-parallel runner

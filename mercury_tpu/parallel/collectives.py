@@ -34,6 +34,18 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from mercury_tpu.compat import shard_map
 
+#: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
+#: everything here is an EXPLICIT collective by design (the study/parity
+#: layer) — called only from inside shard_map/pmap regions, which the
+#: auditor treats as manual SPMD. GL112 (manual all_gather where a
+#: constraint suffices) therefore exempts these call sites; using them
+#: from a GSPMD-auto region is the smell the rule exists to catch.
+SHARDING_CONTRACT = {
+    "allreduce_mean_tree": "lax.pmean per leaf — manual regions only",
+    "psum_stats": "lax.psum pair — manual regions only",
+    "ring_allreduce": "ppermute ring inside shard_map — study path",
+}
+
 
 def allreduce_mean_tree(tree: Any, axis_name: str) -> Any:
     """Average a pytree across the mesh axis (``pytorch_collab.py:236-249``

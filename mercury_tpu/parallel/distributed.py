@@ -27,6 +27,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mercury_tpu.parallel.mesh import make_mesh
 
+#: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
+#: multi-host placement promises. Global arrays are assembled from
+#: per-host shards with explicit NamedShardings (GL111: no bare
+#: device_put); the global mesh's data axis spans all hosts, so the
+#: in-graph collectives of the single-host plans carry over unchanged.
+SHARDING_CONTRACT = {
+    "global batch": "P(data) over the pod-wide mesh",
+    "host slices": "host_worker_slice rows only — no cross-host gather",
+    "params": "replicated (or fsdp/tp shardings from their modules)",
+}
+
 
 def initialize(
     coordinator_address: Optional[str] = None,

@@ -29,6 +29,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mercury_tpu.compat import donate_argnums
 
+#: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
+#: params/opt-state leaves carry fsdp_shardings (largest divisible dim
+#: over the data axis, small leaves replicated); gradients are pinned to
+#: the SAME layout with with_sharding_constraint inside the step, so the
+#: backward's reduce-scatters land sharded instead of GSPMD choosing to
+#: all-gather; batch inputs ride P(data); loss comes back replicated.
+SHARDING_CONTRACT = {
+    "params": "fsdp_shardings(params): largest W-divisible dim sharded",
+    "opt_state": "inherits the param shardings (ZeRO-2 for free)",
+    "grads": "with_sharding_constraint to the param shardings",
+    "x, y": "P(data) on the batch axis",
+    "loss": "replicated",
+}
+
 
 def fsdp_shardings(params, mesh: Mesh, axis: str = "data",
                    min_size: int = 1024):
@@ -82,16 +96,6 @@ def make_fsdp_train_step(
     batch_sharding = data_sharding(mesh, axis)
     replicated = replicated_sharding(mesh)
 
-    def step(params, opt_state, x, y):
-        def loss_fn(p):
-            logits = model.apply({"params": p}, x, train=True)
-            return jnp.mean(per_sample_loss(logits, y))
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
     def canon(x):
         """Leaves created off-mesh (e.g. optax's scalar ``count`` from
         ``jnp.zeros``) join the mesh replicated; mesh-placed leaves pass
@@ -118,9 +122,28 @@ def make_fsdp_train_step(
         if "fn" not in cache:
             params = jax.tree_util.tree_map(canon, params)
             opt_state = jax.tree_util.tree_map(canon, opt_state)
+            param_shardings = shardings_of(params)
+
+            def step(params, opt_state, x, y):
+                def loss_fn(p):
+                    logits = model.apply({"params": p}, x, train=True)
+                    return jnp.mean(per_sample_loss(logits, y))
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                # SHARDING CONTRACT: pin the gradient tree to the param
+                # layout so the backward's reductions land sharded —
+                # without the constraint GSPMD may elect to all-gather
+                # grads before the update, a silent Wx memory/wire cost
+                # (graftlint Layer 3 budgets the compiled collectives).
+                grads = jax.lax.with_sharding_constraint(
+                    grads, param_shardings)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss
+
             cache["fn"] = jax.jit(
                 step,
-                out_shardings=(shardings_of(params), shardings_of(opt_state),
+                out_shardings=(param_shardings, shardings_of(opt_state),
                                replicated),
                 donate_argnums=donate_argnums(0, 1),
             )

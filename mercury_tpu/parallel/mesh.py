@@ -15,6 +15,24 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+#: The canonical mesh-axis registry — the single source of truth for
+#: axis-name literals anywhere in the package. graftlint enforces it
+#: twice: GL113 (Layer 1) flags axis literals outside this set (against
+#: its own stdlib-side mirror, ``lint/rules.py::_MESH_AXES``), and the
+#: Layer 3 sharding audit fails if the mirror drifts from this tuple.
+#: Adding a new axis (e.g. an expert axis) means adding it HERE and to
+#: the mirror — one commit, both layers.
+MESH_AXES = ("data", "model", "seq", "pipe")
+
+#: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
+#: what each helper here promises about placements.
+SHARDING_CONTRACT = {
+    "data_sharding": "leading axis P(data); everything else replicated",
+    "replicated_sharding": "P() on every leaf",
+    "shard_leading_axis": "device_put WITH explicit sharding (GL111)",
+    "replicate": "device_put WITH explicit sharding (GL111)",
+}
+
 
 def make_mesh(
     num_devices: Optional[int] = None,

@@ -54,6 +54,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mercury_tpu.compat import axis_size, pcast, shard_map
 
+#: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
+#: the stacked block params are the ONLY pipe-sharded state; the tick
+#: schedule itself is manual SPMD (shard_map), so its interiors are
+#: exempt from constraint coverage — the contract lives at the edges.
+SHARDING_CONTRACT = {
+    "stacked blocks": "[L, ...] leaves P(pipe) via shard_stacked_blocks",
+    "rest (embed/pos/norm/head)": "replicated",
+    "activations": "ppermute stage-to-stage inside shard_map",
+    "batch": "replicated over the pipe axis (every stage sees it)",
+}
+
 
 def stack_block_params(params: dict, num_layers: int) -> Tuple[dict, dict]:
     """Split a :class:`~mercury_tpu.models.TransformerClassifier` param tree

@@ -37,6 +37,19 @@ import jax.numpy as jnp
 from mercury_tpu.compat import axis_size
 from jax import lax
 
+#: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
+#: ring/Ulysses attention runs INSIDE shard_map (manual SPMD), so the
+#: auditor exempts its interiors from with_sharding_constraint coverage;
+#: the contract is on the boundary instead. The fp32 online-softmax
+#: carry is deliberate and exempt from the bf16-leak check (it never
+#: feeds a dot in a scoring scope — it IS the accumulator).
+SHARDING_CONTRACT = {
+    "q/k/v": "[B, L, H, D] with L sharded over the seq axis at entry",
+    "k/v blocks": "streamed by lax.ppermute — never gathered",
+    "softmax state": "(acc, row_max, row_sum) fp32, device-local",
+    "output": "[B, L_loc, H, D] — same seq sharding as the query",
+}
+
 NEG_INF = -1e30
 
 

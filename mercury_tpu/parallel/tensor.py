@@ -30,6 +30,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+#: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
+#: the Megatron layout below is expressed purely as parameter shardings —
+#: no manual collectives — so XLA owns every all-reduce. The auditor
+#: budgets the compiled collective count per plan; a dropped sharding
+#: here shows up as a collective-count / peak-memory diff, not a crash.
+SHARDING_CONTRACT = {
+    "qkv kernels / MLP up": "P(None, model) — column-parallel",
+    "proj / MLP down": "P(model, None) — row-parallel, psum by XLA",
+    "norms, embeddings, head": "replicated",
+    "activations": "unannotated — GSPMD propagates from the params",
+}
+
 # (suffix of the flattened param path) → partition spec builder.
 _COLUMN_KERNELS = ("query/kernel", "key/kernel", "value/kernel",
                    "Dense_0/kernel")                 # output-feature split

@@ -87,6 +87,7 @@ def make_pp_mercury_step(
     ema_alpha: float = 0.9,
     moe_aux_weight: float = TrainConfig.moe_aux_weight,
     telemetry: bool = False,
+    io_constraints: bool = True,
 ) -> Callable[..., Tuple[PPMercuryState, dict]]:
     """Build ``step(state, x_train, y_train) → (state, metrics)``.
 
@@ -110,6 +111,14 @@ def make_pp_mercury_step(
     (``sampler/ess``, ``sampler/clip_frac``, ``sampler/ema_drift``,
     ``train/grad_norm`` — see ``obs/diagnostics.py``); gated at trace
     time, so the default traces the original program.
+
+    SHARDING CONTRACT (graftlint Layer 3): ``x_train``/``y_train`` are
+    pinned replicated over the pipe mesh (``P()``) with
+    ``with_sharding_constraint`` at the step boundary — every stage
+    reads the worker's full shard (stage 0 injects microbatches, the
+    last stage emits), so a pipe-sharded input would silently all-gather
+    per tick. ``io_constraints=False`` drops the pins (and the plan's
+    ``sharding_constraints`` budget with them).
     """
     pool_size = presample_batches * batch_size
     if pool_size % num_microbatches or batch_size % num_microbatches:
@@ -120,8 +129,16 @@ def make_pp_mercury_step(
     moe = getattr(model, "moe_experts", None) is not None
     pp_fwd = make_pp_apply(model, mesh, num_microbatches, axis,
                            with_aux=moe)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep_ns = NamedSharding(mesh, P())
 
     def step(state: PPMercuryState, x_train, y_train):
+        if io_constraints:
+            # SHARDING CONTRACT (see docstring): the shard data stays
+            # replicated over the pipe axis.
+            x_train = jax.lax.with_sharding_constraint(x_train, rep_ns)
+            y_train = jax.lax.with_sharding_constraint(y_train, rep_ns)
         k_stream, k_sel, k_next = jax.random.split(state.rng, 3)
         stream, slots = next_pool(state.stream, k_stream, pool_size)
         pool_x = x_train[slots]

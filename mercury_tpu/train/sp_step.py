@@ -160,6 +160,7 @@ def make_dp_sp_mercury_step(
     data_axis: str = "data",
     seq_axis: str = "seq",
     telemetry: bool = False,
+    io_constraints: bool = True,
 ) -> Callable[..., Tuple["SpMercuryState", dict]]:
     """The FULL Mercury IS algorithm on a 2-D ``data × seq`` mesh —
     completing the composition matrix's IS×SP cell (IS×TP and IS×PP
@@ -202,6 +203,13 @@ def make_dp_sp_mercury_step(
     ``train/grad_norm`` — see ``obs/diagnostics.py``) to the metrics
     dict; gated at trace time, so the default traces the original
     program.
+
+    SHARDING CONTRACT (graftlint Layer 3): ``x_train``/``y_train`` are
+    pinned replicated (``P()``) with ``with_sharding_constraint`` at the
+    step boundary — the replicated-input contract above made explicit,
+    so a sharded caller array reshards once, visibly, instead of GSPMD
+    re-laying-out the interior. ``io_constraints=False`` drops the pins
+    (and the plan's ``sharding_constraints`` budget with them).
     """
     pool_size = presample_batches * batch_size
     w_seq = mesh.shape[seq_axis]
@@ -333,6 +341,19 @@ def make_dp_sp_mercury_step(
         out_specs=(state_specs, P()),
         check_vma=False,
     )
+    if io_constraints:
+        from jax.sharding import NamedSharding
+
+        # SHARDING CONTRACT (see docstring): pin the replicated-input
+        # contract at the boundary, outside the shard_map.
+        rep_ns = NamedSharding(mesh, P())
+        constrained_inner = sharded
+
+        def sharded(state, x_train, y_train):
+            x_train = jax.lax.with_sharding_constraint(x_train, rep_ns)
+            y_train = jax.lax.with_sharding_constraint(y_train, rep_ns)
+            return constrained_inner(state, x_train, y_train)
+
     if not zigzag:
         return jax.jit(sharded, donate_argnums=donate_argnums(0))
 

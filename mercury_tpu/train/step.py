@@ -133,6 +133,7 @@ def make_train_step(
     scan_steps: int = 1,
     state_out_shardings=None,
     scoring_model=None,
+    io_constraints: bool = True,
 ) -> Callable[..., Tuple[MercuryState, Dict[str, jax.Array]]]:
     """Build the jitted train step.
 
@@ -151,6 +152,17 @@ def make_train_step(
     when given, the candidate-scoring forward runs through it instead of
     ``model`` — the IS reweight divides by the realized probabilities, so
     a lower-precision scorer reranks candidates without biasing the loss.
+
+    SHARDING CONTRACT (enforced by graftlint Layer 3, ``lint/
+    sharding.py`` — see docs/LINT.md): the step's inputs are pinned with
+    ``with_sharding_constraint`` before they enter the shard_map —
+    ``x_train``/``y_train`` to the data spec (``P(axis)`` when
+    ``data_placement`` shards them, else replicated ``P()``) and
+    ``shard_indices`` to ``P(axis)`` — so a caller handing in foreign
+    layouts pays one visible reshard here instead of GSPMD quietly
+    rewriting layouts inside the step. ``io_constraints=False`` drops
+    the pins (the per-plan ``sharding_constraints`` budget in
+    ``lint/shard_budgets.json`` then fails — that is the point).
     """
     axis = config.mesh_axis
     use_is = config.use_importance_sampling
@@ -921,6 +933,26 @@ def make_train_step(
                 state, x_train, y_train, shard_indices)
             return new_state.replace(
                 rng=jax.random.wrap_key_data(new_state.rng)), metrics
+
+    if io_constraints:
+        from jax.sharding import NamedSharding
+
+        # SHARDING CONTRACT (see docstring): pin the data inputs' layouts
+        # at the step boundary, outside the shard_map, so any caller-side
+        # layout drift surfaces as one explicit reshard here — not as
+        # GSPMD rewrites inside the program. Layer 3 budgets these
+        # constraint ops per plan (lint/shard_budgets.json).
+        data_ns = NamedSharding(mesh, data_spec)
+        idx_ns = NamedSharding(mesh, P(axis))
+        constrained_inner = sharded
+
+        def sharded(state, x_train, y_train, shard_indices):
+            x_train = jax.lax.with_sharding_constraint(x_train, data_ns)
+            y_train = jax.lax.with_sharding_constraint(y_train, data_ns)
+            shard_indices = jax.lax.with_sharding_constraint(
+                shard_indices, idx_ns)
+            return constrained_inner(state, x_train, y_train,
+                                     shard_indices)
 
     jit_kw = {}
     if state_out_shardings is not None:

@@ -10,8 +10,69 @@ its invariants.
 import numpy as np
 import pytest
 
-from mercury_tpu.analysis import estimate_is_benefit, recommend
+from mercury_tpu.analysis import (
+    collective_footprint,
+    estimate_is_benefit,
+    recommend,
+)
 from mercury_tpu.config import TrainConfig
+
+
+class TestCollectiveFootprint:
+    """Error paths of the interactive footprint probe: plan-name
+    validation and the telemetry host-callback toggle."""
+
+    def test_unknown_plan_raises_before_tracing(self):
+        calls = []
+
+        def fn(x):
+            calls.append(x)  # must never run: validation precedes tracing
+            return x
+
+        with pytest.raises(ValueError, match="unknown plan 'nope'"):
+            collective_footprint(fn, 1.0, plan="nope")
+        assert calls == []
+
+    def test_known_plan_names_accepted(self):
+        import jax.numpy as jnp
+
+        fp = collective_footprint(lambda x: x + 1, jnp.ones(()), plan="dp")
+        assert fp["plan"] == "dp"
+        fp = collective_footprint(lambda x: x + 1, jnp.ones(()))
+        assert fp["plan"] == "adhoc"
+
+    def test_telemetry_false_flags_host_callbacks(self):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(x):
+            jax.debug.print("x={x}", x=x)
+            return x * 2
+
+        fp = collective_footprint(fn, jnp.ones((4,)), telemetry=False)
+        assert fp["host_callbacks"] >= 1
+        assert fp["callback_violations"]
+        assert "telemetry=False" in fp["callback_violations"][0]
+
+    def test_telemetry_true_allows_callbacks(self):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(x):
+            jax.debug.print("x={x}", x=x)
+            return x * 2
+
+        fp = collective_footprint(fn, jnp.ones((4,)), telemetry=True)
+        assert fp["host_callbacks"] >= 1
+        assert fp["callback_violations"] == []
+
+    def test_callback_free_step_clean_either_way(self):
+        import jax.numpy as jnp
+
+        fp = collective_footprint(lambda x: x * 2, jnp.ones((4,)),
+                                  telemetry=False)
+        assert fp["host_callbacks"] == 0
+        assert fp["callback_violations"] == []
 
 
 @pytest.fixture(scope="module")

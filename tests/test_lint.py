@@ -341,6 +341,190 @@ class TestEngine:
 
 # ---------------------------------------------------------------- Layer 2
 
+class TestUnconstrainedJitOutput:
+    """GL110: in_shardings without out_shardings leaves the output layout
+    to GSPMD propagation."""
+
+    def test_in_without_out_fires(self):
+        assert ids("""
+            import jax
+            step = jax.jit(f, in_shardings=(s,), donate_argnums=(0,))
+        """) == ["GL110"]
+
+    def test_both_pinned_clean(self):
+        assert ids("""
+            import jax
+            step = jax.jit(f, in_shardings=(s,), out_shardings=(s,))
+        """) == []
+
+    def test_plain_jit_clean(self):
+        assert ids("""
+            import jax
+            step = jax.jit(f)
+        """) == []
+
+
+class TestUnshardedDevicePut:
+    """GL111: bare device_put in hot modules (path-scoped; '<string>'
+    counts as hot so the fixtures run through lint_source)."""
+
+    def test_bare_device_put_fires(self):
+        assert ids("""
+            import jax
+            x = jax.device_put(x)
+        """) == ["GL111"]
+
+    def test_explicit_sharding_clean(self):
+        assert ids("""
+            import jax
+            x = jax.device_put(x, sharding)
+        """) == []
+
+    def test_device_kwarg_clean(self):
+        assert ids("""
+            import jax
+            x = jax.device_put(x, device=sharding)
+        """) == []
+
+    def test_cold_module_path_clean(self):
+        src = """
+            import jax
+            x = jax.device_put(x)
+        """
+        assert ids(src, path="mercury_tpu/utils/io.py") == []
+        assert ids(src, path="mercury_tpu/parallel/io.py") == ["GL111"]
+
+
+class TestManualAllGather:
+    """GL112: lax.all_gather in jit-traced code where a sharding
+    constraint expresses the same layout; shard_map bodies are manual
+    SPMD and exempt."""
+
+    def test_all_gather_in_jitted_fires(self):
+        assert ids("""
+            import jax
+            from jax import lax
+
+            @jax.jit
+            def f(x):
+                return lax.all_gather(x, "data")
+        """) == ["GL112"]
+
+    def test_shard_map_body_exempt(self):
+        assert ids("""
+            import jax
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+
+            def body(x):
+                return lax.all_gather(x, "data")
+
+            f = shard_map(body, mesh, in_specs=specs, out_specs=specs)
+        """) == []
+
+    def test_untraced_function_clean(self):
+        assert ids("""
+            from jax import lax
+
+            def helper(x):
+                return lax.all_gather(x, "data")
+        """) == []
+
+    def test_non_lax_receiver_clean(self):
+        assert ids("""
+            import jax
+
+            @jax.jit
+            def f(ring):
+                return ring.all_gather()
+        """) == []
+
+
+class TestUnknownMeshAxis:
+    """GL113: axis-name literals off the parallel/mesh.py registry."""
+
+    def test_bad_partition_spec_fires(self):
+        assert ids("""
+            s = P("batch")
+        """) == ["GL113"]
+
+    def test_bad_default_param_fires(self):
+        assert ids("""
+            def f(x, axis="replica"):
+                return x
+        """) == ["GL113"]
+
+    def test_bad_mesh_ctor_fires(self):
+        assert ids("""
+            m = Mesh(devices, ("data", "expert"))
+        """) == ["GL113"]
+
+    def test_bad_collective_axis_fires(self):
+        assert ids("""
+            from jax import lax
+
+            def f(x):
+                return lax.psum(x, "workers")
+        """) == ["GL113"]
+
+    def test_canonical_axes_clean(self):
+        assert ids("""
+            from jax import lax
+
+            def f(x, axis="data", sp_axis="seq"):
+                m = Mesh(devices, ("data", "model"))
+                s = PartitionSpec("pipe", None)
+                return lax.pmean(x, axis_name="model")
+        """) == []
+
+    def test_non_axis_string_args_ignored(self):
+        # Positional strings outside axis slots and unrelated kwargs must
+        # not be treated as axis names.
+        assert ids("""
+            def f():
+                log("batch")
+                open("data.txt", mode="r")
+        """) == []
+
+    def test_registry_matches_mesh_module(self):
+        # The stdlib-side mirror must track parallel/mesh.py (Layer 3
+        # fails the audit on drift; this is the jax-free half).
+        from mercury_tpu.lint.rules import _MESH_AXES
+        from mercury_tpu.parallel.mesh import MESH_AXES
+
+        assert tuple(_MESH_AXES) == tuple(MESH_AXES)
+
+
+class TestCliJson:
+    """--json v2: envelope with a schema version and a per-finding
+    layer tag."""
+
+    def test_envelope_and_layer_tag(self, tmp_path, capsys):
+        from mercury_tpu.lint import cli
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("def f(xs=[]):\n    return xs\n")
+        rc = cli.main(["--json", str(bad)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert doc["schema"] == "graftlint_findings_v2"
+        [finding] = doc["findings"]
+        assert finding["layer"] == "ast"
+        assert finding["severity"] == "error"
+        assert finding["rule_id"] == "GL104"
+        assert finding["path"] == str(bad)
+
+    def test_clean_run_empty_findings(self, tmp_path, capsys):
+        from mercury_tpu.lint import cli
+
+        ok = tmp_path / "ok.py"
+        ok.write_text("def f(xs=None):\n    return xs\n")
+        rc = cli.main(["--json", str(ok)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc == {"schema": "graftlint_findings_v2", "findings": []}
+
+
 class TestAuditBudgets:
     """Budget comparison logic on a once-measured dp plan (one trace,
     class-scoped); corruption must fail with a readable diff."""
@@ -400,6 +584,20 @@ class TestAuditBudgets:
         errors, warnings = audit.compare_budgets([dp], budgets)
         assert errors == []
         assert any("jaxpr_sha256" in w for w in warnings)
+
+    def test_foreign_jax_version_demotes_collective_counts(self, dp):
+        """The demotion must cover collective-count mismatches too, not
+        just the digest — HLO/trace details drift across jax releases."""
+        from mercury_tpu.lint import audit
+
+        budgets = json.loads(json.dumps(audit.load_budgets()))
+        budgets["provenance"]["jax"] = "0.0.0-not-this"
+        plan = budgets["plans"]["dp"]
+        plan["collectives"]["psum"] = plan["collectives"].get("psum", 0) + 3
+        errors, warnings = audit.compare_budgets([dp], budgets)
+        assert errors == []
+        assert any("psum expected" in w for w in warnings)
+        assert any("recorded under jax" in w for w in warnings)
 
     def test_callback_invariant_catches_telemetry_leak(self, dp):
         from mercury_tpu.lint import audit

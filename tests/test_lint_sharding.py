@@ -1,0 +1,301 @@
+"""graftlint Layer 3: sharding/memory auditor fixtures.
+
+Covers the two acceptance failure modes from ISSUE 4 — a deliberately
+dropped ``with_sharding_constraint`` and an f32 value leaking into a
+bf16 scoring region — plus the constraint-coverage walker, the memory
+ratchet, budget-diff readability, foreign-jax demotion, and the
+axis-registry anti-drift check. Toy programs keep the compiles tiny so
+most of this runs in tier-1; the full plan matrix is slow-tier."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mercury_tpu.lint import memory as lint_memory
+from mercury_tpu.lint import sharding
+from mercury_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(2, "data")
+
+
+def toy_step(mesh, constrained=True):
+    """Tiny data-parallel step: batch pinned P('data'), a scoring-scope
+    matmul, scalar loss. ``constrained=False`` is the dropped-constraint
+    acceptance fixture."""
+    ns = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def step(x, w):
+        if constrained:
+            x = jax.lax.with_sharding_constraint(x, ns)
+        with jax.named_scope("mercury_scoring"):
+            y = x @ w
+        return jnp.sum(y)
+
+    return step
+
+
+def toy_args():
+    return jnp.ones((8, 16)), jnp.ones((16, 4))
+
+
+def toy_budgets(measurement):
+    """A budgets document recorded from ``measurement`` under the running
+    jax version (so comparisons run in hard-error mode)."""
+    return {
+        "schema": sharding.SCHEMA,
+        "provenance": {"jax": jax.__version__,
+                       "memory_tolerance": lint_memory.DEFAULT_TOLERANCE},
+        "plans": {measurement.plan: measurement.as_budget()},
+    }
+
+
+class TestMeasurement:
+    def test_constraints_and_memory_measured(self, mesh):
+        m = sharding.measure_shard_step(
+            toy_step(mesh), toy_args(), "toy", {})
+        assert m.sharding_constraints == 1
+        assert m.memory.get("peak_estimate_in_bytes", 0) > 0
+        assert sharding.check_shard_invariants(m) == []
+
+    def test_self_comparison_clean(self, mesh):
+        m = sharding.measure_shard_step(
+            toy_step(mesh), toy_args(), "toy", {})
+        errors, warnings = sharding.compare_shard_budgets(
+            [m], toy_budgets(m))
+        assert errors == [], "\n".join(errors)
+        assert warnings == []
+
+    def test_missing_plan_budget_is_an_error(self, mesh):
+        m = sharding.measure_shard_step(
+            toy_step(mesh), toy_args(), "toy", {})
+        doc = toy_budgets(m)
+        doc["plans"] = {}
+        errors, _ = sharding.compare_shard_budgets([m], doc)
+        assert any("no committed shard budget" in e for e in errors)
+
+    def test_wrong_schema_rejected(self, tmp_path):
+        p = tmp_path / "shard_budgets.json"
+        p.write_text(json.dumps({"schema": "something_else", "plans": {}}))
+        with pytest.raises(ValueError, match="schema"):
+            sharding.load_shard_budgets(str(p))
+
+
+class TestDroppedConstraint:
+    """Acceptance fixture: budget recorded WITH the constraint, program
+    measured WITHOUT it — must fail with a readable per-plan diff."""
+
+    def test_readable_diff(self, mesh):
+        good = sharding.measure_shard_step(
+            toy_step(mesh, constrained=True), toy_args(), "toy", {})
+        bad = sharding.measure_shard_step(
+            toy_step(mesh, constrained=False), toy_args(), "toy", {})
+        errors, _ = sharding.compare_shard_budgets(
+            [bad], toy_budgets(good))
+        diff = "\n".join(errors)
+        assert "plan toy" in diff
+        assert "sharding_constraints expected 1, got 0" in diff
+        assert "dropped" in diff
+        assert "--regen" in diff or "regenerate" in diff
+
+    def test_foreign_jax_demotes_to_warning(self, mesh):
+        good = sharding.measure_shard_step(
+            toy_step(mesh, constrained=True), toy_args(), "toy", {})
+        bad = sharding.measure_shard_step(
+            toy_step(mesh, constrained=False), toy_args(), "toy", {})
+        doc = toy_budgets(good)
+        doc["provenance"]["jax"] = "0.0.0-not-this"
+        errors, warnings = sharding.compare_shard_budgets([bad], doc)
+        assert errors == []
+        assert any("sharding_constraints expected" in w for w in warnings)
+        assert any("recorded under jax" in w for w in warnings)
+
+
+class TestF32ScoringLeak:
+    """Acceptance fixture: f32 reaching a dot inside mercury_scoring when
+    the plan declares bf16 scoring — the dataflow walk must name it."""
+
+    def leaky_step(self):
+        def step(x, w):
+            with jax.named_scope("mercury_scoring"):
+                xb = x.astype(jnp.bfloat16)
+                # f32 path: w never casts, and an elementwise chain keeps
+                # it f32 all the way into the dot (the mixed-operand
+                # promotion Layer 2's all-f32 dot check misses).
+                wf = w * 2.0
+                return jnp.sum(
+                    xb.astype(jnp.float32) @ wf)
+        return step
+
+    def test_leak_reported_with_origin(self, mesh):
+        m = sharding.measure_shard_step(
+            jax.jit(self.leaky_step()), toy_args(), "toy_bf16",
+            {"scoring_dtype": "bfloat16"})
+        assert m.f32_scoring_leaks, "leak not detected"
+        msg = m.f32_scoring_leaks[0]
+        assert "mercury_scoring" in msg
+        assert "f32" in msg
+        errors = sharding.check_shard_invariants(m)
+        assert any("mercury_scoring" in e for e in errors)
+
+    def test_leak_is_always_an_error_even_cross_version(self, mesh):
+        good = sharding.measure_shard_step(
+            toy_step(mesh), toy_args(), "toy_bf16", {})
+        bad = sharding.measure_shard_step(
+            jax.jit(self.leaky_step()), toy_args(), "toy_bf16",
+            {"scoring_dtype": "bfloat16"})
+        doc = toy_budgets(good)
+        doc["provenance"]["jax"] = "0.0.0-not-this"
+        errors, _ = sharding.compare_shard_budgets([bad], doc)
+        assert any("f32" in e for e in errors)
+
+    def test_clean_bf16_scoring_has_no_leaks(self):
+        def step(x, w):
+            with jax.named_scope("mercury_scoring"):
+                return jnp.sum(
+                    x.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16))
+
+        closed = jax.make_jaxpr(step)(*toy_args())
+        assert sharding.f32_scoring_leaks(closed, "toy") == []
+
+    def test_f32_dot_outside_scope_ignored(self):
+        def step(x, w):
+            return jnp.sum(x @ w)  # f32 dot, but not a scoring region
+
+        closed = jax.make_jaxpr(step)(*toy_args())
+        assert sharding.f32_scoring_leaks(closed, "toy") == []
+
+
+class TestConstraintCoverage:
+    """lint/memory.py's jaxpr walker, pointed at THIS file via the
+    modules parameter (the real run points it at parallel/)."""
+
+    MODULES = ("tests/test_lint_sharding.py",)
+
+    def test_unconstrained_intermediate_reported(self):
+        def f(a, b):
+            big = a @ b
+            return jnp.sum(big)
+
+        closed = jax.make_jaxpr(f)(jnp.ones((32, 32)), jnp.ones((32, 32)))
+        msgs = lint_memory.unconstrained_large_intermediates(
+            closed, modules=self.MODULES, min_bytes=2048)
+        assert len(msgs) == 1
+        assert "with_sharding_constraint" in msgs[0]
+        assert "test_lint_sharding.py" in msgs[0]
+
+    def test_constrained_intermediate_clean(self, mesh):
+        ns = NamedSharding(mesh, P())
+
+        def f(a, b):
+            big = jax.lax.with_sharding_constraint(a @ b, ns)
+            return jnp.sum(big)
+
+        closed = jax.make_jaxpr(f)(jnp.ones((32, 32)), jnp.ones((32, 32)))
+        assert lint_memory.unconstrained_large_intermediates(
+            closed, modules=self.MODULES, min_bytes=2048) == []
+
+    def test_shard_map_interior_exempt(self, mesh):
+        from mercury_tpu.compat import shard_map
+
+        def body(a, b):
+            big = a @ b          # manual SPMD: constraint meaningless
+            return jnp.sum(big)
+
+        f = shard_map(body, mesh=mesh, in_specs=(P(), P()),
+                      out_specs=P())
+        closed = jax.make_jaxpr(f)(jnp.ones((32, 32)), jnp.ones((32, 32)))
+        assert lint_memory.unconstrained_large_intermediates(
+            closed, modules=self.MODULES, min_bytes=2048) == []
+
+    def test_small_intermediates_ignored(self):
+        def f(a, b):
+            return jnp.sum(a @ b)
+
+        closed = jax.make_jaxpr(f)(jnp.ones((32, 32)), jnp.ones((32, 32)))
+        assert lint_memory.unconstrained_large_intermediates(
+            closed, modules=self.MODULES,
+            min_bytes=lint_memory.MIN_CONSTRAINED_BYTES) == []
+
+
+class TestMemoryRatchet:
+    def test_growth_past_tolerance_errors(self):
+        errors, warnings = lint_memory.compare_memory(
+            "dp", {"temp_size_in_bytes": 1000},
+            {"temp_size_in_bytes": 1300}, tolerance=0.25)
+        assert any("exceeds budget" in e for e in errors)
+        assert warnings == []
+
+    def test_shrink_past_tolerance_warns(self):
+        errors, warnings = lint_memory.compare_memory(
+            "dp", {"temp_size_in_bytes": 1000},
+            {"temp_size_in_bytes": 700}, tolerance=0.25)
+        assert errors == []
+        assert any("regenerate" in w for w in warnings)
+
+    def test_within_tolerance_clean(self):
+        assert lint_memory.compare_memory(
+            "dp", {"temp_size_in_bytes": 1000},
+            {"temp_size_in_bytes": 1200}, tolerance=0.25) == ([], [])
+
+    def test_missing_profile_skips(self):
+        assert lint_memory.compare_memory("dp", {}, {}) == ([], [])
+
+
+class TestUnscopedResharding:
+    def test_unscoped_growth_flagged_as_resharding(self, mesh):
+        m = sharding.measure_shard_step(
+            toy_step(mesh), toy_args(), "toy", {})
+        doc = toy_budgets(m)
+        grown = sharding.ShardMeasurement(plan="toy", config={})
+        grown.sharding_constraints = m.sharding_constraints
+        grown.unscoped_trace_collectives = dict(
+            m.unscoped_trace_collectives)
+        grown.hlo_collectives = dict(m.hlo_collectives)
+        grown.hlo_scoped_collectives = {
+            k: dict(v) for k, v in m.hlo_scoped_collectives.items()}
+        grown.hlo_unscoped_collectives = dict(
+            m.hlo_unscoped_collectives)
+        grown.hlo_unscoped_collectives["all-gather"] = (
+            grown.hlo_unscoped_collectives.get("all-gather", 0) + 2)
+        grown.memory = dict(m.memory)
+        errors, _ = sharding.compare_shard_budgets([grown], doc)
+        diff = "\n".join(errors)
+        assert "all-gather expected 0, got 2" in diff
+        assert "implicit resharding outside the mercury scopes" in diff
+
+
+class TestAxisRegistry:
+    def test_registry_in_sync(self):
+        assert sharding.check_axis_registry() == []
+
+
+@pytest.mark.slow
+class TestShardingMatrix:
+    """Full plan matrix vs the committed shard_budgets.json (one AOT
+    compile per plan — slow tier; the lint-sharding CI job runs the same
+    through the CLI)."""
+
+    def test_all_plans_verify(self):
+        errors, warnings = sharding.run_sharding_audit()
+        assert errors == [], "\n".join(errors + warnings)
+
+    def test_diff_out_written_on_mismatch(self, tmp_path):
+        budgets = sharding.load_shard_budgets()
+        budgets["provenance"]["jax"] = jax.__version__  # hard mode
+        budgets["plans"]["dp"]["sharding_constraints"] += 1
+        broken = tmp_path / "shard_budgets.json"
+        broken.write_text(json.dumps(budgets))
+        out = tmp_path / "diff.txt"
+        errors, _ = sharding.run_sharding_audit(
+            plans=("dp",), budgets_path=str(broken), diff_out=str(out))
+        assert errors
+        text = out.read_text()
+        assert "graftlint sharding diff" in text
+        assert "sharding_constraints" in text and "dropped" in text
