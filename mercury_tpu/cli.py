@@ -113,21 +113,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"run: {config.run_name()}  mesh: {trainer.mesh.shape}  "
               f"steps/epoch: {trainer.steps_per_epoch}")
         if args.audit:
+            import jax
+
             from mercury_tpu.analysis import collective_footprint
 
+            # host_stream's step takes a streamed pixel batch instead of
+            # the resident array; a shape/dtype template traces identically
+            # (make_jaxpr never touches values).
+            step_x = trainer._step_x
+            if config.data_placement == "host_stream":
+                staging = trainer._stream_pipe._staging[0]
+                step_x = jax.ShapeDtypeStruct(staging.shape, staging.dtype)
             fp = collective_footprint(
-                trainer.train_step, trainer.state, trainer._step_x,
+                trainer.train_step, trainer.state, step_x,
                 trainer._step_y, trainer.dataset.shard_indices,
                 telemetry=config.telemetry,
             )
             print(json.dumps(fp, indent=2))
             return 0
         if args.dry_run:
-            state, metrics = trainer.train_step(
-                trainer.state, trainer._step_x, trainer._step_y,
-                trainer.dataset.shard_indices,
-            )
-            trainer.state = state
+            if config.data_placement == "host_stream":
+                # pop→step→push, including the lookahead index hand-off —
+                # the same loop fit() drives.
+                metrics = trainer._host_stream_step()
+            else:
+                state, metrics = trainer.train_step(
+                    trainer.state, trainer._step_x, trainer._step_y,
+                    trainer.dataset.shard_indices,
+                )
+                trainer.state = state
             print(json.dumps({k: float(v) for k, v in metrics.items()}))
             return 0
         final = trainer.fit()

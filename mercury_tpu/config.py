@@ -65,8 +65,28 @@ class TrainConfig:
     # and in multi-controller runs each host transfers only its own
     # workers' rows (the load_partition_data_distributed_cifar10 pattern,
     # cifar10/data_loader.py:214-245). Train-split eval gathers from the
-    # host copy.
+    # host copy. "host_stream": pixels stay HOST-resident (numpy / memmap)
+    # and only each step's rows cross PCIe — the step emits the NEXT
+    # selection's global indices as an extra output (a lookahead draw,
+    # mirroring pipelined_scoring's carried-PendingBatch design) and a
+    # background thread gathers those rows into pre-allocated staging
+    # buffers and commits them with the step's batch sharding while the
+    # current steps execute (data/stream.py), so H2D fully overlaps
+    # compute. Device train-data memory drops from the full dataset to
+    # prefetch_depth batches (+ the [L] score table for the scoretable
+    # sampler — the only piece importance sampling needs on-device).
+    # Single-process only; requires sampler="pool"|"scoretable",
+    # scan_steps=1, no pipelined_scoring / score-refresh cadence.
     data_placement: str = "replicated"
+    # host_stream: how many batches the prefetch pipeline keeps in flight
+    # (the lookahead distance of the in-graph index draw). The first
+    # prefetch_depth batches are drawn uniformly (cold start). 2 =
+    # classic double buffering.
+    prefetch_depth: int = 2
+    # host_stream: worker threads for the host-side row gather / image
+    # decode (data/stream.py sources). 0 = gather inline on the single
+    # prefetch thread.
+    decode_workers: int = 0
 
     # Optimization ----------------------------------------------------------
     batch_size: int = 32             # per-worker train batch (exp_dataset.py:11,24)

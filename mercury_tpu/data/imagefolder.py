@@ -48,6 +48,29 @@ def find_classes(root: str) -> List[str]:
     )
 
 
+def list_image_folder(root: str) -> Tuple[List[str], np.ndarray, List[str]]:
+    """Enumerate ``root/<class>/<image>`` WITHOUT decoding: ``(paths,
+    labels, class_names)`` in the same deterministic order
+    :func:`load_image_folder` decodes in (classes sorted, files sorted
+    within class) — so a path index here IS the global sample index the
+    sampler attributes scores to. The lazy half of the eager loader,
+    shared with ``data/stream.py``'s ``ImageFolderSource`` (which decodes
+    only the rows a step actually selects)."""
+    classes = find_classes(root)
+    if not classes:
+        raise FileNotFoundError(f"no class subdirectories under {root!r}")
+    paths, labels = [], []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fname in sorted(os.listdir(cdir)):
+            if os.path.splitext(fname)[1].lower() in IMG_EXTENSIONS:
+                paths.append(os.path.join(cdir, fname))
+                labels.append(label)
+    if not paths:
+        raise FileNotFoundError(f"no images with {IMG_EXTENSIONS} under {root!r}")
+    return paths, np.asarray(labels, np.int32), classes
+
+
 def load_image_folder(
     root: str, image_size: Optional[int] = 32
 ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
@@ -59,19 +82,9 @@ def load_image_folder(
     sorted within class — the stable analogue of the reference's
     index-carrying ``(index, sample, target)`` tuples (``util.py:165-181``).
     """
-    classes = find_classes(root)
-    if not classes:
-        raise FileNotFoundError(f"no class subdirectories under {root!r}")
-    images, labels = [], []
-    for label, cls in enumerate(classes):
-        cdir = os.path.join(root, cls)
-        for fname in sorted(os.listdir(cdir)):
-            if os.path.splitext(fname)[1].lower() in IMG_EXTENSIONS:
-                images.append(_load_image(os.path.join(cdir, fname), image_size))
-                labels.append(label)
-    if not images:
-        raise FileNotFoundError(f"no images with {IMG_EXTENSIONS} under {root!r}")
-    return np.stack(images), np.asarray(labels, np.int32), classes
+    paths, labels, classes = list_image_folder(root)
+    images = [_load_image(p, image_size) for p in paths]
+    return np.stack(images), labels, classes
 
 
 def load_imagefolder_dataset(

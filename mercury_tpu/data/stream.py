@@ -1,0 +1,338 @@
+"""Host-streaming input: row sources + the double-buffered prefetch pipeline.
+
+``data_placement="host_stream"`` (``train/step.py::hs_body``) splits each
+step's dataflow in two: the *selection* (which global rows to train on)
+runs in-graph ``prefetch_depth`` steps ahead and is emitted as a small
+int32 index output, while the *pixels* never enter the graph — a
+background thread gathers the selected rows from a host-resident (or
+memory-mapped / lazily-decoded) source into a pre-allocated staging
+buffer and ``jax.device_put``\\ s them with the step's batch sharding
+while the intervening steps execute. Only the score table (4·N bytes)
+must live in HBM for importance sampling; the pixel array does not — the
+sampling-plane/training-plane split of arXiv:1511.06481.
+
+Two row sources implement the same two-method protocol (``row_shape`` /
+``dtype`` attributes, ``gather(gidx, out)``):
+
+- :class:`HostStreamSource` — rows of an in-memory uint8 array or an
+  ``np.memmap`` (datasets larger than host RAM page in on demand);
+- :class:`ImageFolderSource` — lazily-decoded ``root/<class>/<image>``
+  rows (the streaming half of ``data/imagefolder.py``: only the rows a
+  step actually selects are ever decoded).
+
+Both optionally spread the gather/decode over ``decode_workers`` threads
+(PIL decode and ``memmap`` page-ins release the GIL).
+
+:class:`PrefetchPipeline` owns the worker thread and the bounded ready
+queue; the Trainer drives it pop→step→push (``Trainer._host_stream_step``)
+and folds :meth:`stats` (``data/stall_s``, ``data/queue_depth``,
+``data/h2d_bytes``) into the step metrics.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["HostStreamSource", "ImageFolderSource", "PrefetchPipeline"]
+
+
+class HostStreamSource:
+    """Rows from a host-resident array the device never holds.
+
+    ``x`` is any ``[N, ...]`` array-like with numpy fancy indexing — an
+    in-memory ``np.ndarray`` or an ``np.memmap`` over a raw row file
+    (uint8 pixel archives mmap directly; the OS pages rows in as the
+    gather touches them, so the working set is the prefetch window, not
+    the dataset). With ``decode_workers > 0`` the gather is chunked over
+    a thread pool — numpy's gather loop releases the GIL, and memmap
+    page faults overlap across threads.
+    """
+
+    def __init__(self, x, decode_workers: int = 0) -> None:
+        if getattr(x, "ndim", 0) < 1:
+            raise ValueError("HostStreamSource needs an [N, ...] array")
+        self._x = x
+        self.row_shape: Tuple[int, ...] = tuple(x.shape[1:])
+        self.dtype = np.dtype(x.dtype)
+        self._workers = max(int(decode_workers), 0)
+        self._pool = None
+        if self._workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                self._workers, thread_name_prefix="mercury-gather"
+            )
+
+    def __len__(self) -> int:
+        return int(self._x.shape[0])
+
+    def gather(self, gidx: np.ndarray, out: np.ndarray) -> None:
+        """Fill ``out[i] = x[gidx[i]]`` for flat global row ids."""
+        n = int(gidx.shape[0])
+        if self._pool is None:
+            out[:n] = self._x[gidx]
+            return
+        chunk = -(-n // self._workers)
+
+        def fill(lo: int) -> None:
+            hi = min(lo + chunk, n)
+            out[lo:hi] = self._x[gidx[lo:hi]]
+
+        # list() propagates worker exceptions here, on the caller.
+        list(self._pool.map(fill, range(0, n, chunk)))
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+
+class ImageFolderSource:
+    """Lazily-decoded ``root/<class>/<image>`` rows.
+
+    The streaming counterpart of ``data/imagefolder.py``'s eager loader:
+    the same deterministic enumeration (``list_image_folder`` — classes
+    sorted, files sorted within class, so global index ``i`` here is the
+    same sample the eager array's row ``i`` holds), but decode happens
+    per-gather, only for the rows a step selected. ``image_size`` is
+    mandatory: the staging buffers are pre-allocated, so the row shape
+    must be known without decoding the whole folder.
+    """
+
+    def __init__(self, root: str, image_size: int = 32,
+                 decode_workers: int = 0) -> None:
+        from mercury_tpu.data.imagefolder import list_image_folder
+
+        if image_size is None:
+            raise ValueError(
+                "ImageFolderSource needs a fixed image_size (staging "
+                "buffers are pre-allocated)"
+            )
+        self._paths, self.labels, self.classes = list_image_folder(root)
+        self._size = int(image_size)
+        self.row_shape = (self._size, self._size, 3)
+        self.dtype = np.dtype(np.uint8)
+        self._workers = max(int(decode_workers), 0)
+        self._pool = None
+        if self._workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                self._workers, thread_name_prefix="mercury-decode"
+            )
+
+    def __len__(self) -> int:
+        return len(self._paths)
+
+    def gather(self, gidx: np.ndarray, out: np.ndarray) -> None:
+        from mercury_tpu.data.imagefolder import _load_image
+
+        def decode(i: int) -> None:
+            out[i] = _load_image(self._paths[int(gidx[i])], self._size)
+
+        n = int(gidx.shape[0])
+        if self._pool is None:
+            for i in range(n):
+                decode(i)
+            return
+        list(self._pool.map(decode, range(n)))
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+
+_STOP = object()
+_FAILED = object()
+
+
+class PrefetchPipeline:
+    """Bounded double-buffered host→device prefetch.
+
+    ``push(idx)`` hands the worker thread a ``[W, S]`` index array — the
+    train step's third output, usually still an in-flight device value;
+    the worker (not the training thread) blocks on it, gathers the rows
+    into a pre-allocated staging buffer, and commits them to the device
+    with the step's batch sharding. ``pop()`` returns the oldest committed
+    batch; the input-attributable part of its wait (the host gather +
+    H2D dispatch after the selection materialized — see :meth:`pop`) is
+    the *stall*, the number the whole design exists to drive to zero:
+    with ``depth`` selections in flight (the cold-start prime pushes
+    ``depth`` of them), the gather+H2D for step t+depth overlaps the
+    compute of steps t…t+depth-1.
+
+    The queue is bounded at ``depth`` committed batches; the driver's
+    pop→step→push loop keeps exactly ``depth`` items in flight, so memory
+    is ``(depth+1)`` staging-buffer-sized slabs, independent of dataset
+    size. Worker exceptions re-raise on the next :meth:`pop`.
+    """
+
+    def __init__(self, source, batch_shape: Tuple[int, int], sharding,
+                 depth: int = 2, pop_timeout_s: float = 300.0) -> None:
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.source = source
+        self.depth = int(depth)
+        self._batch_shape = tuple(batch_shape)  # (W, S)
+        self._sharding = sharding
+        self._pop_timeout_s = float(pop_timeout_s)
+        w, s = self._batch_shape
+        # depth+1 rotating staging slabs: the worker gathers into slab i
+        # while the commit copies out of slabs i-1…i-depth are still in
+        # flight, so publishing a batch never has to wait for the device.
+        self._staging = [
+            np.empty((w, s) + tuple(source.row_shape), source.dtype)
+            for _ in range(self.depth + 1)
+        ]
+        self._inflight: list = [None] * (self.depth + 1)
+        self._slot = 0
+        import jax
+
+        # The commit copy: device_put of a host buffer may alias it
+        # zero-copy on CPU backends, and the staging slab is REUSED for a
+        # later batch — the identity jit with pinned out_shardings forces
+        # a real device-owned copy (the Trainer._recommit_state idiom),
+        # after which the slab is free again.
+        self._commit = jax.jit(lambda x: x, out_shardings=sharding)
+        self._work: "queue.Queue[Any]" = queue.Queue()
+        self._ready: "queue.Queue[Any]" = queue.Queue(maxsize=self.depth)
+        self._exc: Optional[BaseException] = None
+        self.total_stall_s = 0.0
+        self.total_wait_s = 0.0
+        self.total_h2d_bytes = 0
+        self.pops = 0
+        self._last_stall_s = 0.0
+        self._last_h2d_bytes = 0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._prefetch_loop, name="mercury-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- driving
+    def push(self, idx) -> None:
+        """Enqueue one selection's indices ([W, S], device or host array).
+        Never blocks the training thread: the device sync on ``idx``
+        happens on the worker."""
+        if self._closed:
+            raise RuntimeError("push() on a closed PrefetchPipeline")
+        self._work.put(idx)
+
+    def pop(self):
+        """The oldest committed device batch ([W, S, ...], sharded as
+        constructed). Blocks while the worker catches up.
+
+        Two waits are accounted separately. ``total_wait_s`` is the raw
+        time blocked here — most of it is the worker waiting for the
+        *producing step's* output to materialize, time the device spends
+        on useful compute (the lookahead pipeline's normal cadence, not a
+        problem). ``total_stall_s`` is the input-attributable part: the
+        host-side publish lag (gather + H2D dispatch after the index
+        materialized), clipped to the time actually waited — the number
+        that must stay near zero for the overlap claim to hold."""
+        t0 = time.monotonic()
+        try:
+            item = self._ready.get(timeout=self._pop_timeout_s)
+        except queue.Empty:
+            if self._exc is not None:
+                raise RuntimeError(
+                    "prefetch worker died"
+                ) from self._exc
+            raise TimeoutError(
+                f"no prefetched batch within {self._pop_timeout_s:.0f}s "
+                "(did the driver forget to push()?)"
+            )
+        waited = time.monotonic() - t0
+        self.total_wait_s += waited
+        self.pops += 1
+        if item is _FAILED:
+            raise RuntimeError("prefetch worker died") from self._exc
+        batch, host_lag_s = item
+        self.total_stall_s += min(waited, host_lag_s)
+        return batch
+
+    def stats(self) -> Dict[str, float]:
+        """Interval telemetry since the previous call (the
+        ``AsyncMetricWriter`` contract: per-log-window deltas), plus the
+        instantaneous ready-queue depth."""
+        stall = self.total_stall_s - self._last_stall_s
+        h2d = self.total_h2d_bytes - self._last_h2d_bytes
+        self._last_stall_s = self.total_stall_s
+        self._last_h2d_bytes = self.total_h2d_bytes
+        return {
+            "data/stall_s": stall,
+            "data/queue_depth": float(self._ready.qsize()),
+            "data/h2d_bytes": float(h2d),
+        }
+
+    def reset(self) -> None:
+        """Discard queued work and committed batches (checkpoint-restore
+        refill: the restored ``pending_sel`` re-seeds the ring, so every
+        in-flight batch is for the wrong trajectory)."""
+        self._drain(self._work)
+        self._drain(self._ready)
+
+    @staticmethod
+    def _drain(q: "queue.Queue[Any]") -> None:
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                return
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._work.put(_STOP)
+        self._thread.join(timeout=30.0)
+        close = getattr(self.source, "close", None)
+        if close is not None:
+            close()
+
+    # -------------------------------------------------------------- worker
+    def _prefetch_loop(self) -> None:
+        import jax
+
+        while True:
+            idx = self._work.get()
+            if idx is _STOP:
+                return
+            try:
+                slot = self._slot
+                self._slot = (slot + 1) % len(self._staging)
+                staging = self._staging[slot]
+                prev = self._inflight[slot]
+                if prev is not None:
+                    # Writing into the slab before its previous commit
+                    # copy landed would corrupt that batch. depth+1 slabs
+                    # back, the copy is all but certainly done — this is a
+                    # fence, not a wait, and it bounds only this worker.
+                    prev.block_until_ready()  # graftlint: disable=GL114 -- staging-slab reuse fence; blocks only this worker
+                # The one real sync this thread exists to absorb: idx is
+                # the step's in-flight index output, and materializing it
+                # here means the TRAINING thread never waits for it.
+                idx_h = np.asarray(idx)  # graftlint: disable=GL114 -- absorbing the index sync off the training thread is this worker's purpose
+                t_ready = time.monotonic()
+                self.source.gather(
+                    idx_h.reshape(-1),
+                    staging.reshape((-1,) + tuple(self.source.row_shape)))
+                batch = jax.device_put(staging, self._sharding)
+                batch = self._commit(batch)
+                self._inflight[slot] = batch
+                self.total_h2d_bytes += int(staging.nbytes)
+                # Published async: the commit is enqueued device work the
+                # consuming step serializes behind naturally — blocking on
+                # it here would charge device-queue time as stall. The
+                # host lag rides along for pop()'s stall attribution.
+                self._ready.put((batch, time.monotonic() - t_ready))
+            except BaseException as exc:  # surfaced on the next pop()
+                self._exc = exc
+                self._ready.put(_FAILED)
+                return

@@ -45,7 +45,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 SCHEMA = "graftlint_budgets_v1"
-PLAN_NAMES = ("dp", "zero", "dp_bf16", "sp", "pp")
+PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "sp", "pp")
 
 # The seed step's metric surface — what telemetry=False must reproduce
 # exactly (mirrors benchmarks/telemetry_overhead.py::BASE_KEYS).
@@ -219,7 +219,9 @@ def measure_step(step_fn, args: Tuple, plan: str,
         m.donation_markers = -1  # lowering unavailable; skip the check
 
     out = jax.eval_shape(step_fn, *args)
-    metrics = out[1] if isinstance(out, tuple) and len(out) == 2 else {}
+    # (state, metrics) for the fused plans; (state, metrics, next_gidx)
+    # for host_stream's lookahead step.
+    metrics = out[1] if isinstance(out, tuple) and len(out) >= 2 else {}
     m.metric_keys = sorted(metrics) if isinstance(metrics, dict) else []
     return m
 
@@ -262,6 +264,46 @@ def _build_fused(variant: str):
     ds = trainer.dataset
     args = (trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
     return trainer.train_step, args, dict(kw, plan=variant)
+
+
+def _build_hs():
+    """host_stream dp: the lookahead step (``hs_body``) — pixels arrive
+    as a streamed uint8 batch, the next selection's indices leave as a
+    third output. The pixel argument is a shape/dtype template: tracing
+    and AOT lowering never need values, and the audit must not depend on
+    the prefetch thread having produced anything."""
+    import jax
+
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    kw: Dict[str, Any] = dict(
+        model="smallcnn",
+        dataset="synthetic",
+        world_size=2,
+        batch_size=8,
+        presample_batches=2,
+        sampler="pool",
+        data_placement="host_stream",
+        prefetch_depth=2,
+        num_epochs=1,
+        steps_per_epoch=100,
+        eval_every=0,
+        log_every=0,
+        scan_steps=1,
+        compute_dtype="float32",
+        telemetry=False,
+        heartbeat_every=0,
+        seed=0,
+    )
+    config = TrainConfig(**kw)
+    trainer = Trainer(config, mesh=make_mesh(2, config.mesh_axis))
+    staging = trainer._stream_pipe._staging[0]
+    x_t = jax.ShapeDtypeStruct(staging.shape, staging.dtype)
+    args = (trainer.state, x_t, trainer._step_y,
+            trainer.dataset.shard_indices)
+    return trainer.train_step, args, dict(kw, plan="hs")
 
 
 def _build_sp():
@@ -335,6 +377,7 @@ _BUILDERS = {
     "dp": lambda: _build_fused("dp"),
     "zero": lambda: _build_fused("zero"),
     "dp_bf16": lambda: _build_fused("dp_bf16"),
+    "hs": _build_hs,
     "sp": _build_sp,
     "pp": _build_pp,
 }

@@ -157,6 +157,17 @@ GL113 = _rule(
     "use a canonical axis name (data/model/seq/pipe) or register the "
     "new axis in parallel/mesh.py MESH_AXES",
 )
+GL114 = _rule(
+    "GL114", "worker-device-sync",
+    "blocking device sync (device_get / block_until_ready / numpy "
+    "materialization) inside a thread-worker function (threading.Thread "
+    "target, executor.submit): the worker serializes against device "
+    "execution, stalling the very pipeline it exists to overlap",
+    "keep worker threads host-only; when the sync IS the worker's job "
+    "(e.g. a prefetch thread absorbing an index readback so the training "
+    "thread never waits), suppress with the reason spelled out: "
+    "`# graftlint: disable=GL114 -- <why this thread may block>`",
+)
 
 # Mirror of parallel/mesh.py::MESH_AXES. Layer 1 must not import jax (or
 # anything that does), so the set is duplicated here; Layer 3's audit
@@ -235,9 +246,12 @@ class ModuleAnalysis:
         self.jnp_aliases: Set[str] = set()
         self.lax_aliases: Set[str] = set()
         self._collect_imports()
+        self._collect_defs()
         self.traced: Set[ast.AST] = set()
         self.manual: Set[ast.AST] = set()
         self._detect_traced()
+        self.workers: Set[ast.AST] = set()
+        self._detect_workers()
         self.mutable_globals: Dict[str, int] = {}
         self._collect_mutable_globals()
 
@@ -279,40 +293,60 @@ class ModuleAnalysis:
     def _scope_of(self, node: ast.AST) -> ast.AST:
         return self.enclosing_function(node) or self.tree
 
-    def _detect_traced(self) -> None:
+    def _collect_defs(self) -> None:
         # name -> funcdefs per defining scope, and alias edges
-        # (scope, alias) -> {source names} from `alias = source`.
-        defs: Dict[Tuple[int, str], List[ast.AST]] = {}
-        aliases: Dict[Tuple[int, str], Set[str]] = {}
+        # (scope, alias) -> {source names} from `alias = source`. Shared by
+        # the traced-function and thread-worker detectors.
+        self._defs: Dict[Tuple[int, str], List[ast.AST]] = {}
+        self._aliases: Dict[Tuple[int, str], Set[str]] = {}
         for node in ast.walk(self.tree):
             if isinstance(node, _FUNC_NODES):
                 scope = self._scope_of(node)
-                defs.setdefault((id(scope), node.name), []).append(node)
+                self._defs.setdefault(
+                    (id(scope), node.name), []).append(node)
             elif isinstance(node, ast.Assign) and isinstance(
                     node.value, ast.Name):
                 scope = self._scope_of(node)
                 for t in node.targets:
                     if isinstance(t, ast.Name):
-                        aliases.setdefault(
+                        self._aliases.setdefault(
                             (id(scope), t.id), set()).add(node.value.id)
 
-        def make_marker(target: Set[ast.AST]):
-            seen: Set[Tuple[int, str]] = set()
+    def _make_marker(self, target: Set[ast.AST]):
+        seen: Set[Tuple[int, str]] = set()
 
-            def mark(scope: ast.AST, name: str) -> None:
-                key = (id(scope), name)
-                if key in seen:
-                    return
-                seen.add(key)
-                for src in aliases.get(key, ()):  # fn = body → body too
-                    mark(scope, src)
-                for fn in defs.get(key, ()):
-                    target.add(fn)
+        def mark(scope: ast.AST, name: str) -> None:
+            key = (id(scope), name)
+            if key in seen:
+                return
+            seen.add(key)
+            for src in self._aliases.get(key, ()):  # fn = body → body too
+                mark(scope, src)
+            for fn in self._defs.get(key, ()):
+                target.add(fn)
 
-            return mark
+        return mark
 
-        mark = make_marker(self.traced)
-        mark_manual = make_marker(self.manual)
+    def _propagate_closures(self, *sets: Set[ast.AST]) -> None:
+        # Functions nested inside a marked function share its fate
+        # (trace with it / run on its thread).
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if not isinstance(node, _FUNC_NODES):
+                    continue
+                enc = self.enclosing_function(node)
+                if enc is None:
+                    continue
+                for s in sets:
+                    if enc in s and node not in s:
+                        s.add(node)
+                        changed = True
+
+    def _detect_traced(self) -> None:
+        mark = self._make_marker(self.traced)
+        mark_manual = self._make_marker(self.manual)
 
         def candidate_funcs(arg: ast.AST) -> Iterator[ast.expr]:
             """The function-valued expressions a trace-entry arg carries
@@ -352,23 +386,36 @@ class ModuleAnalysis:
                     if name in _MANUAL_ENTRY_NAMES:
                         self.manual.add(node)
 
-        # closure: functions nested inside a traced (manual) function
-        # trace (run manually) with it
-        changed = True
-        while changed:
-            changed = False
-            for node in ast.walk(self.tree):
-                if not isinstance(node, _FUNC_NODES):
+        self._propagate_closures(self.traced, self.manual)
+
+    # ------------------------------------------------------- worker funcs
+    def _detect_workers(self) -> None:
+        """Functions handed to a background thread: ``threading.Thread``'s
+        ``target=`` and ``executor.submit``'s first argument. The hand-off
+        is structural (no call-graph following): a helper a worker merely
+        *calls* is not marked — GL114 stays scoped to code that is
+        unambiguously on a worker thread."""
+        mark = self._make_marker(self.workers)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            entry = _last_attr(node.func)
+            targets: List[ast.AST] = []
+            if entry == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        targets.append(kw.value)
+            elif entry == "submit" and isinstance(node.func, ast.Attribute) \
+                    and node.args:
+                targets.append(node.args[0])
+            for t in targets:
+                name = _last_attr(t)  # bare name or self._method terminal
+                if name is None:
                     continue
-                enc = self.enclosing_function(node)
-                if enc is None:
-                    continue
-                if enc in self.traced and node not in self.traced:
-                    self.traced.add(node)
-                    changed = True
-                if enc in self.manual and node not in self.manual:
-                    self.manual.add(node)
-                    changed = True
+                mark(self._scope_of(node), name)
+                # Methods and module functions both define at tree scope.
+                mark(self.tree, name)
+        self._propagate_closures(self.workers)
 
     # -------------------------------------------------- mutable globals
     def _collect_mutable_globals(self) -> None:
@@ -937,6 +984,47 @@ def check_unknown_mesh_axis(an: ModuleAnalysis) -> List[RawFinding]:
     return out
 
 
+def check_worker_sync(an: ModuleAnalysis) -> List[RawFinding]:
+    """GL114: blocking device syncs inside thread-worker functions.
+
+    The prefetch/streaming design puts exactly one sync per hand-off on
+    the worker (materializing the in-flight index output, fencing the
+    staging-buffer reuse) — and those sites carry suppressions explaining
+    themselves. Any OTHER sync on a worker thread is the bug this rule
+    exists for: it re-serializes the worker against device execution, so
+    the overlap the thread was spawned to buy quietly disappears.
+    """
+    out: List[RawFinding] = []
+    for fn in an.workers:
+        for node in an.nodes_of_function(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = _last_attr(func)
+            if attr == "block_until_ready":
+                out.append(RawFinding(
+                    GL114, node.lineno, node.col_offset,
+                    "block_until_ready() on a worker thread parks it "
+                    "until device execution drains",
+                ))
+            elif attr == "device_get":
+                out.append(RawFinding(
+                    GL114, node.lineno, node.col_offset,
+                    "jax.device_get on a worker thread is a blocking "
+                    "device→host transfer",
+                ))
+            elif isinstance(func, ast.Attribute) \
+                    and attr in ("asarray", "array"):
+                base = _dotted(func.value)
+                if base and base.split(".")[0] in an.np_aliases:
+                    out.append(RawFinding(
+                        GL114, node.lineno, node.col_offset,
+                        f"numpy {attr}() on a worker thread blocks on "
+                        "any device-resident input it is handed",
+                    ))
+    return out
+
+
 _CHECKS = (
     check_key_reuse,
     check_host_sync,
@@ -950,6 +1038,7 @@ _CHECKS = (
     check_unsharded_device_put,
     check_manual_all_gather,
     check_unknown_mesh_axis,
+    check_worker_sync,
 )
 
 

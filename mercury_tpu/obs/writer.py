@@ -290,7 +290,8 @@ class HeartbeatSink:
     small-model run cannot flood the terminal from the drain thread."""
 
     _KEYS = ("train/loss", "train/acc", "perf/steps_per_s",
-             "perf/examples_per_s", "perf/mfu", "sampler/ess")
+             "perf/examples_per_s", "perf/mfu", "sampler/ess",
+             "data/stall_s")
 
     def __init__(self, every_steps: int = 100, min_interval_s: float = 1.0,
                  stream=None) -> None:

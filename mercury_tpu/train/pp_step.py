@@ -26,7 +26,11 @@ from jax.sharding import Mesh
 
 from mercury_tpu.compat import donate_argnums
 from mercury_tpu.config import TrainConfig
-from mercury_tpu.data.pipeline import ShardStream, init_shard_streams, next_pool
+from mercury_tpu.data.pipeline import (
+    ShardStream,
+    init_shard_streams,
+    next_pool,
+)
 from mercury_tpu.parallel.pipeline import make_pp_apply
 from mercury_tpu.sampling.importance import (
     EMAState,

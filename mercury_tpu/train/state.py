@@ -51,6 +51,23 @@ class PendingBatch(NamedTuple):
     scaled_probs: jax.Array  # [B] float32 — p_i·N for the unbiased reweight
 
 
+class PendingSelection(NamedTuple):
+    """Ring of in-flight sample selections (``data_placement=
+    "host_stream"``): the step at t consumes ``slots[0]`` (its rows arrive
+    pre-gathered from the host via ``data/stream.py``) and pushes the
+    selection it just drew for step t+depth onto the back. The RNG
+    lookahead makes the draws key-for-key identical to the device-resident
+    path: ``rng`` is the worker RNG advanced ``depth`` steps ahead, so the
+    slot draw for step t+d uses exactly the key the replicated step would
+    split at t+d. Carried as raw uint32 key data (not a typed key array)
+    so the leaf shards like any other array under legacy jax."""
+
+    slots: jax.Array         # [depth, S] int32 — shard-local slot ids per step
+    scaled_probs: jax.Array  # [depth, B] float32 — p_i·L at draw time
+                             # (scoretable; ones for uniform/pool)
+    rng: jax.Array           # [2] uint32 — raw key data of rng_{t+depth}
+
+
 @flax.struct.dataclass
 class MercuryState:
     step: jax.Array                 # [] int32 — global step counter
@@ -65,6 +82,7 @@ class MercuryState:
     pending: Any = None             # [W]-stacked PendingBatch (pipelined_scoring)
     cached_pool: Any = None         # [W]-stacked CachedPool (score_refresh_every>1)
     scoretable: Any = None          # [W]-stacked ScoreTableState (sampler="scoretable")
+    pending_sel: Any = None         # [W]-stacked PendingSelection (host_stream)
 
 
 def init_worker_sampler_state(
@@ -102,6 +120,9 @@ def create_state(
     init_opt: bool = True,
     cached_pool_size: int = 0,
     with_scoretable: bool = False,
+    stream_depth: int = 0,
+    stream_emit_size: int = 0,
+    stream_batch_size: int = 0,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -171,6 +192,20 @@ def create_state(
                            1.0 / cached_pool_size, jnp.float32),
             pool_loss=jnp.zeros((n_workers,), jnp.float32),
         )
+    pending_sel = None
+    if stream_depth:
+        # Placeholder only — the jitted prime program (step.py
+        # make_host_stream_prime) overwrites it with depth uniform
+        # cold-start draws (and the advanced lookahead RNG) before the
+        # first step runs; the Trainer feeds the host pipeline from the
+        # prime's emitted indices.
+        pending_sel = PendingSelection(
+            slots=jnp.zeros((n_workers, stream_depth, stream_emit_size),
+                            jnp.int32),
+            scaled_probs=jnp.ones((n_workers, stream_depth,
+                                   stream_batch_size), jnp.float32),
+            rng=jnp.zeros((n_workers, 2), jnp.uint32),
+        )
     scoretable = None
     if with_scoretable:
         # Uniform initial scores over every shard slot — step 0 draws
@@ -192,6 +227,7 @@ def create_state(
         pending=pending,
         cached_pool=cached_pool,
         scoretable=scoretable,
+        pending_sel=pending_sel,
     )
 
 

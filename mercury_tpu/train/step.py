@@ -53,11 +53,18 @@ from mercury_tpu.sampling.importance import (
 from mercury_tpu.sampling.scoretable import (
     ScoreTableState,
     advance_cursor,
+    decay_scores,
     refresh_window,
     scatter_mean,
+    table_probs,
     table_refresh_draw,
 )
-from mercury_tpu.train.state import CachedPool, MercuryState, PendingBatch
+from mercury_tpu.train.state import (
+    CachedPool,
+    MercuryState,
+    PendingBatch,
+    PendingSelection,
+)
 
 from mercury_tpu.compat import (MODERN_JAX, axis_size, donate_argnums,
                                 shard_map)
@@ -66,7 +73,7 @@ from mercury_tpu.compat import (MODERN_JAX, axis_size, donate_argnums,
 def _state_specs(
     axis: str, has_groupwise: bool = False, has_pending: bool = False,
     zero_sharding: bool = False, has_cached_pool: bool = False,
-    has_scoretable: bool = False,
+    has_scoretable: bool = False, has_pending_sel: bool = False,
 ) -> MercuryState:
     """PartitionSpec pytree-prefix for :class:`MercuryState`: model state
     replicated, per-worker sampler state sharded along the data axis;
@@ -84,6 +91,7 @@ def _state_specs(
         pending=P(axis) if has_pending else None,
         cached_pool=P(axis) if has_cached_pool else None,
         scoretable=P(axis) if has_scoretable else None,
+        pending_sel=P(axis) if has_pending_sel else None,
     )
 
 
@@ -91,6 +99,7 @@ def mercury_state_out_shardings(
     mesh: Mesh, axis: str, params_sh, opt_sh,
     has_groupwise: bool = False, has_pending: bool = False,
     has_cached_pool: bool = False, has_scoretable: bool = False,
+    has_pending_sel: bool = False,
 ) -> Tuple[MercuryState, Any]:
     """Output shardings pinning the post-step state layout under partial-
     auto meshes (dp×tp): without this, GSPMD is free to re-replicate the
@@ -119,6 +128,9 @@ def mercury_state_out_shardings(
         pending=n(P(axis)) if has_pending else None,
         cached_pool=n(P(axis)) if has_cached_pool else None,
         scoretable=n(P(axis)) if has_scoretable else None,
+        # Raw uint32 key data (train/state.py PendingSelection) — no PRNG
+        # key leaf, so the tiled sharding is safe on legacy jax too.
+        pending_sel=n(P(axis)) if has_pending_sel else None,
     )
     return state_sh, n(P())
 
@@ -276,7 +288,7 @@ def make_train_step(
         raise ValueError(
             f"unknown importance_score {config.importance_score!r}"
         )
-    if config.data_placement not in ("replicated", "sharded"):
+    if config.data_placement not in ("replicated", "sharded", "host_stream"):
         raise ValueError(
             f"unknown data_placement {config.data_placement!r}"
         )
@@ -284,6 +296,51 @@ def make_train_step(
     # rows sharded P(axis) — each device holds only its own worker's
     # samples, and gathers are shard-local (slots index the row directly).
     data_sharded = config.data_placement == "sharded"
+    # "host_stream": the pixel arrays never enter the graph. The step's
+    # second input is the [W, S, ...] uint8 rows the host pipeline
+    # pre-gathered for THIS step (selected `prefetch_depth` steps ago by
+    # the step itself), and the step emits the NEXT selection's global
+    # indices as a third, non-donated output (out_specs P(axis)) for the
+    # host to gather while the intervening steps run. See hs_body below
+    # and data/stream.py.
+    host_stream = config.data_placement == "host_stream"
+    depth = int(config.prefetch_depth)
+    if host_stream:
+        if depth < 1:
+            raise ValueError(f"prefetch_depth must be >= 1, got {depth}")
+        if pipelined:
+            raise ValueError(
+                "host_stream already pipelines selection (the lookahead "
+                "draw); pipelined_scoring does not compose with it"
+            )
+        if use_cadence:
+            raise ValueError(
+                "host_stream requires score_refresh_every == 1: the "
+                "cached-pool cadence redraws from slots whose rows were "
+                "never streamed"
+            )
+        if use_groupwise:
+            raise ValueError(
+                "host_stream supports sampler='pool'|'scoretable' (and "
+                "the uniform baseline); the groupwise window draw depends "
+                "on post-update scores and cannot be drawn ahead"
+            )
+        if scan_steps > 1:
+            raise ValueError(
+                "host_stream requires scan_steps == 1: each step consumes "
+                "one host-prefetched batch and emits the next indices — a "
+                "scanned chunk would need the streamed batches mid-graph"
+            )
+        if auto_axes:
+            raise ValueError(
+                "host_stream requires a data-only mesh (no tensor/fsdp "
+                "axis); drop tensor_parallel/fsdp_parallel"
+            )
+    # Streamed rows per worker per step: the candidate pool for the pool
+    # sampler (selection happens in-step on the streamed rows), the
+    # refresh window + the pre-drawn train batch for the scoretable one.
+    emit_size = ((refresh_size + batch_size) if use_scoretable
+                 else pool_size)
 
     def _loss_per_sample(logits, labels):
         if use_pallas:
@@ -369,6 +426,207 @@ def make_train_step(
         )
         return sel.selected, sel.scaled_probs, sel.ema, sel.avg_pool_loss
 
+    def score_rows(state, raw, labs, ka):
+        """Augment → inference-mode scoring forward over already-gathered
+        rows — the pool-scoring core shared by the device-resident
+        ``score_slots`` prologue and the host-stream body (whose rows
+        arrive pre-gathered from the host pipeline). Callers wrap the
+        call in the ``mercury_scoring`` named scope the jaxpr auditor
+        anchors on (one scope per call site — nesting would rename the
+        anchor). Returns ``(imgs, pool_logits, scores)``."""
+        imgs = _augment(ka, normalize_images(raw, mean, std))
+        if scoring_model is None:
+            pool_logits, _, _ = _apply_train(
+                state.params, state.batch_stats, imgs, False
+            )
+        else:
+            # Same params, lower-precision compute (scoring_dtype) —
+            # scores only rank candidates, and the reweight divides by
+            # the realized probs, so this stays unbiased.
+            variables = {"params": state.params}
+            mutable = ["losses"]
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                mutable = ["batch_stats", "losses"]
+            pool_logits, _ = scoring_model.apply(
+                variables, imgs, train=True, mutable=mutable
+            )
+            pool_logits = pool_logits.astype(jnp.float32)
+        return imgs, pool_logits, _score_per_sample(pool_logits, labs)
+
+    def train_update(state, rng, sel_images, sel_labels, scaled_probs):
+        """The train back-end — the second half of the fused step, split
+        from the per-sampler selection front-ends so the host-stream body
+        (which consumes a batch selected ``prefetch_depth`` steps ago)
+        shares it verbatim with the device-resident paths: reweighted
+        fwd/bwd, optional gradient compression, the gradient collective
+        (plain allreduce or ZeRO-1 reduce-scatter/all-gather, int8 wire
+        variants), optimizer apply, and the BN-stat sync. Returns a dict
+        with the new model/optimizer state, the train logits (the
+        scoretable write-back re-scores them for free), and the
+        replicated loss/acc reductions."""
+        # fold_in (not a 9-way split) so the eight existing streams — and
+        # every recorded seeded trajectory — are unchanged by the
+        # compression feature's existence.
+        k_quant = jax.random.fold_in(rng, 0x71)  # graftlint: disable=GL101 -- deliberate sentinel stream: fold_in(rng, 0x71) is disjoint from the 8-way split, preserving recorded trajectories
+
+        # --- train forward/backward with the unbiased IS reweighting
+        # mean(loss_i/(N·p_i)) (:132-148) --------------------------------
+        def loss_fn(params):
+            logits, new_bs, aux = _apply_train(
+                params, state.batch_stats, sel_images, True
+            )
+            losses = _loss_per_sample(logits, sel_labels)
+            total = reweighted_loss(losses, scaled_probs)
+            if config.moe_experts is not None:
+                # Switch load-balancing term (sowed by the MoE blocks).
+                total = total + config.moe_aux_weight * aux
+            return total, (logits, new_bs, aux)
+
+        (loss, (logits, new_batch_stats, moe_aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+
+        # --- optional quantization: each worker stochastically quantizes
+        # its local gradient (independent keys); the mean across workers
+        # stays unbiased — the live version of the reference's dead-code
+        # experiment (util.py:65-70; "sparse rate", pytorch_collab.py:184).
+        # Estimator semantics only: the psum below still moves dense
+        # tensors (see TrainConfig.grad_compression).
+        sparse_rate = jnp.ones((), jnp.float32)
+        if compress_grads:
+            from mercury_tpu.utils.quantize import sparsity, stochastic_quantize
+
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            qkeys = jax.random.split(k_quant, len(leaves))
+            leaves = [stochastic_quantize(k, g) for k, g in zip(qkeys, leaves)]
+            grads = jax.tree_util.tree_unflatten(treedef, leaves)
+            total = float(sum(g.size for g in leaves))
+            sparse_rate = sum(sparsity(g) * (g.size / total) for g in leaves)
+
+        loss_mean = lax.pmean(loss, axis)
+        correct = lax.psum(
+            jnp.sum((jnp.argmax(logits, -1) == sel_labels).astype(jnp.float32)), axis
+        )
+        count = lax.psum(jnp.asarray(batch_size, jnp.float32), axis)
+
+        grad_norm = None
+        if zero:
+            # --- ZeRO-1: reduce-scatter the flattened gradient (each worker
+            # receives the mean of its 1/W chunk — reduce-scatter +
+            # all-gather IS the ring allreduce, util.py:280-324, so the
+            # collective volume matches average_gradients :236-249), update
+            # only that chunk's optimizer state, all-gather the updates.
+            # With grad_compression="int8", BOTH wire phases move int8
+            # payloads (per-chunk scales, stochastic rounding — unbiased):
+            # the gradient reduce-scatter and the update all-gather, 4×
+            # fewer bytes each (parallel/collectives.py).
+            from mercury_tpu.utils.tree import (
+                pad_to_chunks,
+                tree_flatten_to_vector,
+            )
+
+            w = axis_size(axis)
+            opt_chunk = jax.tree_util.tree_map(lambda x: x[0], state.opt_state)
+            gvec, unravel = tree_flatten_to_vector(grads)
+            if int8_allreduce:
+                from mercury_tpu.parallel.collectives import (
+                    compressed_all_gather,
+                    compressed_psum_scatter_mean,
+                )
+
+                kz = jax.random.fold_in(rng, 0x72)  # graftlint: disable=GL101 -- deliberate sentinel stream 0x72 for int8 grad compression, disjoint from the 8-way split and 0x71
+                kz1, kz2 = jax.random.split(kz)
+                # mercury_grad_sync scopes anchor the jaxpr auditor's
+                # per-region collective budgets (lint/audit.py).
+                with jax.named_scope("mercury_grad_sync"):
+                    gchunk = compressed_psum_scatter_mean(
+                        pad_to_chunks(gvec, w), axis, kz1
+                    )
+            else:
+                with jax.named_scope("mercury_grad_sync"):
+                    gchunk = (
+                        lax.psum_scatter(pad_to_chunks(gvec, w), axis) / w
+                    )
+            if telemetry:
+                # The chunks partition the full mean-gradient vector (the
+                # pad is zeros), so psum of the per-chunk square-sums is the
+                # exact global norm² — one scalar on the wire.
+                grad_norm = jnp.sqrt(lax.psum(
+                    jnp.sum(jnp.square(gchunk.astype(jnp.float32))), axis
+                ))
+            pvec, _ = tree_flatten_to_vector(state.params)
+            pchunk = pad_to_chunks(pvec, w)[lax.axis_index(axis)]
+            updates_chunk, new_opt_chunk = tx.update(gchunk, opt_chunk, pchunk)
+            if int8_allreduce:
+                with jax.named_scope("mercury_grad_sync"):
+                    uvec = compressed_all_gather(updates_chunk, axis, kz2)[
+                        : gvec.size
+                    ]
+            else:
+                with jax.named_scope("mercury_grad_sync"):
+                    uvec = lax.all_gather(
+                        updates_chunk, axis, tiled=True
+                    )[: gvec.size]
+            new_params = optax.apply_updates(state.params, unravel(uvec))
+            new_opt_state = jax.tree_util.tree_map(
+                lambda x: x[None], new_opt_chunk
+            )
+        else:
+            # --- gradient allreduce (≡ average_gradients, :236-249) in-graph
+            if int8_allreduce:
+                # int8 on the wire, both phases (collectives.py); unbiased.
+                if tp_active:
+                    # Per-leaf, shape-preserving compression: the wire
+                    # chunking avoids the dims TP/FSDP shard, so the
+                    # grads stay sharded through both phases.
+                    from mercury_tpu.parallel.collectives import (
+                        compressed_pmean_tree_sharded,
+                    )
+
+                    with jax.named_scope("mercury_grad_sync"):
+                        grads = compressed_pmean_tree_sharded(
+                            grads, axis, axis_size(axis),
+                            # graftlint: disable=GL101 -- same deliberate 0x72 sentinel stream as the ZeRO branch (mutually exclusive at trace time)
+                            jax.random.fold_in(rng, 0x72),
+                            specs=sharded_param_specs,
+                        )
+                else:
+                    from mercury_tpu.parallel.collectives import (
+                        compressed_allreduce_mean_tree,
+                    )
+
+                    with jax.named_scope("mercury_grad_sync"):
+                        grads = compressed_allreduce_mean_tree(
+                            grads, axis, axis_size(axis),
+                            # graftlint: disable=GL101 -- same deliberate 0x72 sentinel stream as the ZeRO branch (mutually exclusive at trace time)
+                            jax.random.fold_in(rng, 0x72),
+                        )
+            else:
+                with jax.named_scope("mercury_grad_sync"):
+                    grads = allreduce_mean_tree(grads, axis)
+            if telemetry:
+                # Post-allreduce: already the worker-mean gradient, so the
+                # norm is identical on every worker (replicated output).
+                grad_norm = global_grad_norm(grads)
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+
+        # Keep replicated BN stats replicated: under synced BN they already
+        # agree; under local BN we average the running stats across workers
+        # (normalization still used local batch stats this step).
+        if new_batch_stats:
+            new_batch_stats = allreduce_mean_tree(new_batch_stats, axis)
+
+        return dict(
+            loss_mean=loss_mean, acc=correct / count, logits=logits,
+            moe_aux=moe_aux, sparse_rate=sparse_rate, grad_norm=grad_norm,
+            new_params=new_params, new_batch_stats=new_batch_stats,
+            new_opt_state=new_opt_state,
+        )
+
     def body(state: MercuryState, x_train, y_train, shard_indices):
         # Leading axis inside shard_map is this device's single worker row.
         if data_sharded:
@@ -384,10 +642,6 @@ def make_train_step(
         rng = state.rng[0]
         (k_stream, k_aug, k_sel, k_aug2, k_boot_stream, k_boot_aug,
          k_boot_sel, k_next) = jax.random.split(rng, 8)
-        # fold_in (not a 9-way split) so the eight existing streams — and
-        # every recorded seeded trajectory — are unchanged by the
-        # compression feature's existence.
-        k_quant = jax.random.fold_in(rng, 0x71)  # graftlint: disable=GL101 -- deliberate sentinel stream: fold_in(rng, 0x71) is disjoint from the 8-way split, preserving recorded trajectories
 
         groupwise = None
         new_pending = None
@@ -411,27 +665,8 @@ def make_train_step(
             checks (e.g. bf16-scoring dot dtypes) on this anchor."""
             with jax.named_scope("mercury_scoring"):
                 raw, labs = gather_train(slots)
-                imgs = _augment(ka, normalize_images(raw, mean, std))
-                if scoring_model is None:
-                    pool_logits, _, _ = _apply_train(
-                        state.params, state.batch_stats, imgs, False
-                    )
-                else:
-                    # Same params, lower-precision compute (scoring_dtype) —
-                    # scores only rank candidates, and the reweight divides by
-                    # the realized probs, so this stays unbiased.
-                    variables = {"params": state.params}
-                    mutable = ["losses"]
-                    if state.batch_stats:
-                        variables["batch_stats"] = state.batch_stats
-                        mutable = ["batch_stats", "losses"]
-                    pool_logits, _ = scoring_model.apply(
-                        variables, imgs, train=True, mutable=mutable
-                    )
-                    pool_logits = pool_logits.astype(jnp.float32)
-                return imgs, labs, pool_logits, _score_per_sample(
-                    pool_logits, labs
-                )
+                imgs, pool_logits, scores = score_rows(state, raw, labs, ka)
+                return imgs, labs, pool_logits, scores
 
         if pipelined:
             # --- pipelined scoring: train on the batch selected last step,
@@ -669,22 +904,10 @@ def make_train_step(
                 scaled_probs = jnp.ones((batch_size,), jnp.float32)
                 avg_pool_loss = jnp.zeros((), jnp.float32)
 
-        # --- train forward/backward with the unbiased IS reweighting
-        # mean(loss_i/(N·p_i)) (:132-148) --------------------------------
-        def loss_fn(params):
-            logits, new_bs, aux = _apply_train(
-                params, state.batch_stats, sel_images, True
-            )
-            losses = _loss_per_sample(logits, sel_labels)
-            total = reweighted_loss(losses, scaled_probs)
-            if config.moe_experts is not None:
-                # Switch load-balancing term (sowed by the MoE blocks).
-                total = total + config.moe_aux_weight * aux
-            return total, (logits, new_bs, aux)
-
-        (loss, (logits, new_batch_stats, moe_aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+        upd = train_update(state, rng, sel_images, sel_labels, scaled_probs)
+        logits = upd["logits"]
+        if telemetry:
+            grad_norm = upd["grad_norm"]
 
         new_scoretable = state.scoretable
         if use_scoretable:
@@ -704,143 +927,11 @@ def make_train_step(
                 lambda x: x[None], new_table
             )
 
-        # --- optional quantization: each worker stochastically quantizes
-        # its local gradient (independent keys); the mean across workers
-        # stays unbiased — the live version of the reference's dead-code
-        # experiment (util.py:65-70; "sparse rate", pytorch_collab.py:184).
-        # Estimator semantics only: the psum below still moves dense
-        # tensors (see TrainConfig.grad_compression).
-        sparse_rate = jnp.ones((), jnp.float32)
-        if compress_grads:
-            from mercury_tpu.utils.quantize import sparsity, stochastic_quantize
-
-            leaves, treedef = jax.tree_util.tree_flatten(grads)
-            qkeys = jax.random.split(k_quant, len(leaves))
-            leaves = [stochastic_quantize(k, g) for k, g in zip(qkeys, leaves)]
-            grads = jax.tree_util.tree_unflatten(treedef, leaves)
-            total = float(sum(g.size for g in leaves))
-            sparse_rate = sum(sparsity(g) * (g.size / total) for g in leaves)
-
-        loss_mean = lax.pmean(loss, axis)
-        correct = lax.psum(
-            jnp.sum((jnp.argmax(logits, -1) == sel_labels).astype(jnp.float32)), axis
-        )
-        count = lax.psum(jnp.asarray(batch_size, jnp.float32), axis)
-
-        if zero:
-            # --- ZeRO-1: reduce-scatter the flattened gradient (each worker
-            # receives the mean of its 1/W chunk — reduce-scatter +
-            # all-gather IS the ring allreduce, util.py:280-324, so the
-            # collective volume matches average_gradients :236-249), update
-            # only that chunk's optimizer state, all-gather the updates.
-            # With grad_compression="int8", BOTH wire phases move int8
-            # payloads (per-chunk scales, stochastic rounding — unbiased):
-            # the gradient reduce-scatter and the update all-gather, 4×
-            # fewer bytes each (parallel/collectives.py).
-            from mercury_tpu.utils.tree import (
-                pad_to_chunks,
-                tree_flatten_to_vector,
-            )
-
-            w = axis_size(axis)
-            opt_chunk = jax.tree_util.tree_map(lambda x: x[0], state.opt_state)
-            gvec, unravel = tree_flatten_to_vector(grads)
-            if int8_allreduce:
-                from mercury_tpu.parallel.collectives import (
-                    compressed_all_gather,
-                    compressed_psum_scatter_mean,
-                )
-
-                kz = jax.random.fold_in(rng, 0x72)  # graftlint: disable=GL101 -- deliberate sentinel stream 0x72 for int8 grad compression, disjoint from the 8-way split and 0x71
-                kz1, kz2 = jax.random.split(kz)
-                # mercury_grad_sync scopes anchor the jaxpr auditor's
-                # per-region collective budgets (lint/audit.py).
-                with jax.named_scope("mercury_grad_sync"):
-                    gchunk = compressed_psum_scatter_mean(
-                        pad_to_chunks(gvec, w), axis, kz1
-                    )
-            else:
-                with jax.named_scope("mercury_grad_sync"):
-                    gchunk = (
-                        lax.psum_scatter(pad_to_chunks(gvec, w), axis) / w
-                    )
-            if telemetry:
-                # The chunks partition the full mean-gradient vector (the
-                # pad is zeros), so psum of the per-chunk square-sums is the
-                # exact global norm² — one scalar on the wire.
-                grad_norm = jnp.sqrt(lax.psum(
-                    jnp.sum(jnp.square(gchunk.astype(jnp.float32))), axis
-                ))
-            pvec, _ = tree_flatten_to_vector(state.params)
-            pchunk = pad_to_chunks(pvec, w)[lax.axis_index(axis)]
-            updates_chunk, new_opt_chunk = tx.update(gchunk, opt_chunk, pchunk)
-            if int8_allreduce:
-                with jax.named_scope("mercury_grad_sync"):
-                    uvec = compressed_all_gather(updates_chunk, axis, kz2)[
-                        : gvec.size
-                    ]
-            else:
-                with jax.named_scope("mercury_grad_sync"):
-                    uvec = lax.all_gather(
-                        updates_chunk, axis, tiled=True
-                    )[: gvec.size]
-            new_params = optax.apply_updates(state.params, unravel(uvec))
-            new_opt_state = jax.tree_util.tree_map(
-                lambda x: x[None], new_opt_chunk
-            )
-        else:
-            # --- gradient allreduce (≡ average_gradients, :236-249) in-graph
-            if int8_allreduce:
-                # int8 on the wire, both phases (collectives.py); unbiased.
-                if tp_active:
-                    # Per-leaf, shape-preserving compression: the wire
-                    # chunking avoids the dims TP/FSDP shard, so the
-                    # grads stay sharded through both phases.
-                    from mercury_tpu.parallel.collectives import (
-                        compressed_pmean_tree_sharded,
-                    )
-
-                    with jax.named_scope("mercury_grad_sync"):
-                        grads = compressed_pmean_tree_sharded(
-                            grads, axis, axis_size(axis),
-                            # graftlint: disable=GL101 -- same deliberate 0x72 sentinel stream as the ZeRO branch (mutually exclusive at trace time)
-                            jax.random.fold_in(rng, 0x72),
-                            specs=sharded_param_specs,
-                        )
-                else:
-                    from mercury_tpu.parallel.collectives import (
-                        compressed_allreduce_mean_tree,
-                    )
-
-                    with jax.named_scope("mercury_grad_sync"):
-                        grads = compressed_allreduce_mean_tree(
-                            grads, axis, axis_size(axis),
-                            # graftlint: disable=GL101 -- same deliberate 0x72 sentinel stream as the ZeRO branch (mutually exclusive at trace time)
-                            jax.random.fold_in(rng, 0x72),
-                        )
-            else:
-                with jax.named_scope("mercury_grad_sync"):
-                    grads = allreduce_mean_tree(grads, axis)
-            if telemetry:
-                # Post-allreduce: already the worker-mean gradient, so the
-                # norm is identical on every worker (replicated output).
-                grad_norm = global_grad_norm(grads)
-            updates, new_opt_state = tx.update(
-                grads, state.opt_state, state.params
-            )
-            new_params = optax.apply_updates(state.params, updates)
-
-        # Keep replicated BN stats replicated: under synced BN they already
-        # agree; under local BN we average the running stats across workers
-        # (normalization still used local batch stats this step).
-        if new_batch_stats:
-            new_batch_stats = allreduce_mean_tree(new_batch_stats, axis)
-
         new_state = MercuryState(
             step=state.step + 1,
-            params=new_params,
-            batch_stats=new_batch_stats,
-            opt_state=new_opt_state,
+            params=upd["new_params"],
+            batch_stats=upd["new_batch_stats"],
+            opt_state=upd["new_opt_state"],
             ema=EMAState(value=ema.value[None], count=ema.count[None]),
             stream=ShardStream(perm=stream.perm[None], cursor=stream.cursor[None]),
             rng=k_next[None],
@@ -857,13 +948,14 @@ def make_train_step(
                 if use_cadence else state.cached_pool
             ),
             scoretable=new_scoretable,
+            pending_sel=state.pending_sel,
         )
         metrics = {
-            "train/loss": loss_mean,
-            "train/acc": correct / count,
+            "train/loss": upd["loss_mean"],
+            "train/acc": upd["acc"],
             "train/pool_loss": lax.pmean(avg_pool_loss, axis),
-            "train/sparse_rate": lax.pmean(sparse_rate, axis),
-            "train/moe_aux": lax.pmean(moe_aux, axis),
+            "train/sparse_rate": lax.pmean(upd["sparse_rate"], axis),
+            "train/moe_aux": lax.pmean(upd["moe_aux"], axis),
         }
         if telemetry:
             metrics["sampler/ess"] = lax.pmean(
@@ -880,7 +972,205 @@ def make_train_step(
                 metrics["sampler/table_age_max"] = age_max
         return new_state, metrics
 
-    if scan_steps > 1:
+    def hs_body(state: MercuryState, x_stream, y_train, shard_indices):
+        """Host-stream step: train on the batch whose indices were drawn
+        ``prefetch_depth`` steps ago (the front of the ``PendingSelection``
+        ring — its pixel rows arrive pre-gathered in ``x_stream``), then
+        draw the selection for step t+depth and emit its GLOBAL indices as
+        a third, non-donated output for the host prefetch pipeline. The
+        lookahead draw for step u consumes the same key positions of
+        rng_u's 8-way split that the device-resident body would consume AT
+        step u (``sel_ks[0]``/``sel_ks[2]``), carried in ``psel.rng`` — so
+        uniform and pool selections (param-independent draws) are
+        bit-identical to ``replicated``, while the scoretable draw sees a
+        depth-step-stale table (the ``pipelined_scoring`` trade, one step
+        deeper); the carried draw-time ``scaled_probs`` keep the IS
+        reweighting unbiased either way."""
+        # x_stream: [1, S, ...] — this worker's pre-gathered rows for the
+        # ring front (scoretable: refresh window rows ‖ train rows).
+        xs = x_stream[0]
+        rng = state.rng[0]
+        (k_stream, k_aug, k_sel, k_aug2, k_boot_stream, k_boot_aug,
+         k_boot_sel, k_next) = jax.random.split(rng, 8)
+
+        stream = ShardStream(perm=state.stream.perm[0],
+                             cursor=state.stream.cursor[0])
+        ema = EMAState(value=state.ema.value[0], count=state.ema.count[0])
+        psel = jax.tree_util.tree_map(lambda x: x[0], state.pending_sel)
+        # rng_{t+depth}'s split — the lookahead draw's key material.
+        sel_ks = jax.random.split(jax.random.wrap_key_data(psel.rng), 8)
+        front = psel.slots[0]
+
+        if telemetry:
+            clip_frac = jnp.zeros((), jnp.float32)
+            drift = jnp.zeros((), jnp.float32)
+
+        if use_scoretable:
+            # Streamed layout: rows 0:R are the step-t refresh window
+            # (deterministic round-robin — drawn without the table),
+            # rows R: are the train rows selected depth steps ago.
+            refresh_slots = front[:refresh_size]
+            train_slots = front[refresh_size:]
+            with jax.named_scope("mercury_scoring"):
+                r_labels = y_train[shard_indices[0][refresh_slots]]
+                _, r_logits, r_scores = score_rows(
+                    state, xs[:refresh_size], r_labels, k_aug
+                )
+            score_avg = pool_mean(r_scores, stat_axis)
+            ema_prev = ema.value
+            ema = ema_update(ema, score_avg, config.ema_alpha)
+            table = jax.tree_util.tree_map(lambda x: x[0], state.scoretable)
+            # Same decay → refresh-scatter as table_refresh_draw; the draw
+            # half ran depth steps ago, so only the table update remains.
+            refreshed = scatter_mean(
+                decay_scores(
+                    table.scores.astype(jnp.float32), ema.value,
+                    config.table_decay,
+                ),
+                refresh_slots, r_scores,
+            )
+            sel_labels = y_train[shard_indices[0][train_slots]]
+            sel_images = _augment(
+                k_aug2, normalize_images(xs[refresh_size:], mean, std)
+            )
+            scaled_probs = psel.scaled_probs[0]
+            avg_pool_loss = _pool_loss_metric(r_logits, r_labels, score_avg)
+            if telemetry:
+                drift = ema_drift(score_avg, ema_prev)
+                age_min, age_mean, age_max = table_age_summary(
+                    table.cursor, table.scores.shape[0], refresh_size
+                )
+        elif use_is:
+            # Pool sampler: the streamed rows ARE the candidate pool drawn
+            # depth steps ago with rng_t's stream key; scoring + selection
+            # happen in-step with rng_t's k_aug/k_sel — bit-identical to
+            # the device-resident inline path.
+            labs = y_train[shard_indices[0][front]]
+            with jax.named_scope("mercury_scoring"):
+                imgs, pool_logits, pool_losses = score_rows(
+                    state, xs, labs, k_aug
+                )
+            ema_prev = ema.value
+            selected, scaled_probs, ema, score_avg = _select(
+                k_sel, pool_losses, ema
+            )
+            avg_pool_loss = _pool_loss_metric(pool_logits, labs, score_avg)
+            sel_images = imgs[selected]
+            sel_labels = labs[selected]
+            if telemetry:
+                clip_frac = clip_fraction(
+                    pool_losses, ema.value, config.is_alpha
+                )
+                drift = ema_drift(score_avg, ema_prev)
+        else:
+            # Uniform baseline (pool_size == batch_size): consume the
+            # streamed rows directly, unit IS weights.
+            sel_labels = y_train[shard_indices[0][front]][:batch_size]
+            sel_images = _augment(
+                k_aug, normalize_images(xs, mean, std)
+            )[:batch_size]
+            scaled_probs = jnp.ones((batch_size,), jnp.float32)
+            avg_pool_loss = jnp.zeros((), jnp.float32)
+
+        upd = train_update(state, rng, sel_images, sel_labels, scaled_probs)
+        logits = upd["logits"]
+        if telemetry:
+            grad_norm = upd["grad_norm"]
+
+        # --- lookahead draw for step t+depth -----------------------------
+        next_scaled = jnp.ones((batch_size,), jnp.float32)
+        new_scoretable = state.scoretable
+        if use_scoretable:
+            # Write-back first (train logits re-score the trained slots),
+            # then draw from the freshest table this host can have.
+            train_scores = _score_per_sample(
+                logits.astype(jnp.float32), sel_labels
+            )
+            table_after = scatter_mean(refreshed, train_slots, train_scores)
+            n_slots = table_after.shape[0]
+            probs_next = table_probs(table_after, ema.value, config.is_alpha)
+            next_sel = draw_with_replacement(
+                sel_ks[2], probs_next, batch_size
+            ).astype(jnp.int32)
+            next_scaled = probs_next[next_sel] * n_slots
+            # The refresh window for step t+depth is cursor-deterministic:
+            # depth more R-sized round-robin advances from here.
+            next_window = (
+                (table.cursor + depth * refresh_size
+                 + jnp.arange(refresh_size)) % n_slots
+            ).astype(jnp.int32)
+            next_slots = jnp.concatenate([next_window, next_sel])
+            new_table = ScoreTableState(
+                scores=table_after,
+                cursor=advance_cursor(table, refresh_size),
+            )
+            new_scoretable = jax.tree_util.tree_map(
+                lambda x: x[None], new_table
+            )
+            if telemetry:
+                # Clip over the table the NEXT draw normalizes (the
+                # freshest distribution this step produced).
+                clip_frac = clip_fraction(
+                    table_after, ema.value, config.is_alpha
+                )
+        else:
+            # Uniform/pool: the draw is param-independent, so running it
+            # depth steps early with rng_{t+depth}'s stream key reproduces
+            # the device-resident sequence exactly.
+            stream, next_slots = next_pool(stream, sel_ks[0], emit_size)
+            next_slots = next_slots.astype(jnp.int32)
+
+        new_psel = PendingSelection(
+            slots=jnp.concatenate([psel.slots[1:], next_slots[None]], 0),
+            scaled_probs=jnp.concatenate(
+                [psel.scaled_probs[1:], next_scaled[None]], 0
+            ),
+            rng=jax.random.key_data(sel_ks[7]),
+        )
+        # Global ids for the host gather — the pipeline's only view of the
+        # draw (slots are shard-local; the host indexes the global array).
+        next_gidx = shard_indices[0][next_slots][None]
+
+        new_state = MercuryState(
+            step=state.step + 1,
+            params=upd["new_params"],
+            batch_stats=upd["new_batch_stats"],
+            opt_state=upd["new_opt_state"],
+            ema=EMAState(value=ema.value[None], count=ema.count[None]),
+            stream=ShardStream(perm=stream.perm[None],
+                               cursor=stream.cursor[None]),
+            rng=k_next[None],
+            groupwise=state.groupwise,
+            pending=state.pending,
+            cached_pool=state.cached_pool,
+            scoretable=new_scoretable,
+            pending_sel=jax.tree_util.tree_map(
+                lambda x: x[None], new_psel
+            ),
+        )
+        metrics = {
+            "train/loss": upd["loss_mean"],
+            "train/acc": upd["acc"],
+            "train/pool_loss": lax.pmean(avg_pool_loss, axis),
+            "train/sparse_rate": lax.pmean(upd["sparse_rate"], axis),
+            "train/moe_aux": lax.pmean(upd["moe_aux"], axis),
+        }
+        if telemetry:
+            metrics["sampler/ess"] = lax.pmean(
+                ess_fraction(scaled_probs), axis
+            )
+            metrics["sampler/clip_frac"] = lax.pmean(clip_frac, axis)
+            metrics["sampler/ema_drift"] = lax.pmean(drift, axis)
+            metrics["train/grad_norm"] = grad_norm
+            if use_scoretable:
+                metrics["sampler/table_age_min"] = age_min
+                metrics["sampler/table_age_mean"] = age_mean
+                metrics["sampler/table_age_max"] = age_max
+        return new_state, metrics, next_gidx
+
+    if host_stream:
+        fn = hs_body
+    elif scan_steps > 1:
         def chunk(state, x_train, y_train, shard_indices):
             def scan_body(s, _):
                 return body(s, x_train, y_train, shard_indices)
@@ -894,7 +1184,8 @@ def make_train_step(
     specs = _state_specs(axis, has_groupwise=use_groupwise,
                          has_pending=pipelined, zero_sharding=zero,
                          has_cached_pool=use_cadence,
-                         has_scoretable=use_scoretable)
+                         has_scoretable=use_scoretable,
+                         has_pending_sel=host_stream)
     smap_kw = {}
     if auto_axes:
         # Manual over the data axis only; GSPMD handles the rest.
@@ -915,12 +1206,18 @@ def make_train_step(
             return new_state.replace(
                 rng=jax.random.key_data(new_state.rng)), metrics
 
-    data_spec = P(axis) if data_sharded else P()
+    # host_stream: x is the per-worker streamed rows ([W, S, ...] — sharded
+    # like the indices that drew them) while y stays the replicated label
+    # table the in-graph gathers index; the third output is the next
+    # selection's global indices, one row per worker.
+    x_spec = P(axis) if (data_sharded or host_stream) else P()
+    y_spec = P(axis) if data_sharded else P()
+    out_specs_t = (specs, P(), P(axis)) if host_stream else (specs, P())
     sharded = shard_map(
         fn,
         mesh=mesh,
-        in_specs=(specs, data_spec, data_spec, P(axis)),
-        out_specs=(specs, P()),
+        in_specs=(specs, x_spec, y_spec, P(axis)),
+        out_specs=out_specs_t,
         check_vma=False,
         **smap_kw,
     )
@@ -942,13 +1239,14 @@ def make_train_step(
         # layout drift surfaces as one explicit reshard here — not as
         # GSPMD rewrites inside the program. Layer 3 budgets these
         # constraint ops per plan (lint/shard_budgets.json).
-        data_ns = NamedSharding(mesh, data_spec)
+        x_ns = NamedSharding(mesh, x_spec)
+        y_ns = NamedSharding(mesh, y_spec)
         idx_ns = NamedSharding(mesh, P(axis))
         constrained_inner = sharded
 
         def sharded(state, x_train, y_train, shard_indices):
-            x_train = jax.lax.with_sharding_constraint(x_train, data_ns)
-            y_train = jax.lax.with_sharding_constraint(y_train, data_ns)
+            x_train = jax.lax.with_sharding_constraint(x_train, x_ns)
+            y_train = jax.lax.with_sharding_constraint(y_train, y_ns)
             shard_indices = jax.lax.with_sharding_constraint(
                 shard_indices, idx_ns)
             return constrained_inner(state, x_train, y_train,
@@ -958,6 +1256,89 @@ def make_train_step(
     if state_out_shardings is not None:
         jit_kw["out_shardings"] = state_out_shardings
     return jax.jit(sharded, donate_argnums=donate_argnums(0), **jit_kw)
+
+
+def make_host_stream_prime(config: TrainConfig, mesh: Mesh):
+    """Cold-start primer for ``data_placement="host_stream"``: one jitted
+    shard_map that draws the first ``prefetch_depth`` selections UNIFORMLY
+    (the reference's cold start — the table/scores don't exist yet),
+    advancing the per-worker rng/stream chains exactly as ``hs_body``'s
+    lookahead would have, and fills the ``PendingSelection`` ring.
+
+    Returns ``prime(state, shard_indices) -> (state, gidx)`` with ``gidx``
+    ``[depth, W, S]`` int32 global indices — one prefetch push per ring
+    slot. For uniform/pool samplers the primed draws are the exact draws
+    ``replicated`` would make at steps 0..depth-1 (``next_pool`` with each
+    step's stream key), so trajectories match from step 0; the scoretable
+    sampler primes with uniform-with-replacement draws plus the
+    deterministic round-robin refresh windows (unit ``scaled_probs`` keep
+    step 0..depth-1 unbiased)."""
+    axis = config.mesh_axis
+    depth = int(config.prefetch_depth)
+    use_is = bool(config.use_importance_sampling)
+    use_scoretable = use_is and config.sampler == "scoretable"
+    batch_size = int(config.batch_size)
+    pool_size = int(config.candidate_pool_size) if use_is else int(
+        config.batch_size)
+    refresh_size = int(config.refresh_size)
+    emit_size = ((refresh_size + batch_size) if use_scoretable
+                 else pool_size)
+
+    def prime(state: MercuryState, shard_indices):
+        stream = ShardStream(perm=state.stream.perm[0],
+                             cursor=state.stream.cursor[0])
+        sel_rng = state.rng[0]
+        slots_steps = []
+        for i in range(depth):
+            ks = jax.random.split(sel_rng, 8)
+            if use_scoretable:
+                table = jax.tree_util.tree_map(
+                    lambda x: x[0], state.scoretable
+                )
+                n = table.scores.shape[0]
+                window = (
+                    (table.cursor + i * refresh_size
+                     + jnp.arange(refresh_size)) % n
+                ).astype(jnp.int32)
+                # Uniform-with-replacement through the SAME draw kernel the
+                # steady state uses, on the flat distribution — consumes
+                # k_sel exactly as hs_body's lookahead will.
+                drawn = draw_with_replacement(
+                    ks[2], jnp.full((n,), 1.0 / n, jnp.float32), batch_size
+                ).astype(jnp.int32)
+                slots_i = jnp.concatenate([window, drawn])
+            else:
+                stream, slots_i = next_pool(stream, ks[0], emit_size)
+                slots_i = slots_i.astype(jnp.int32)
+            slots_steps.append(slots_i)
+            sel_rng = ks[7]
+        slots = jnp.stack(slots_steps)                 # [depth, S]
+        gidx = shard_indices[0][slots]                 # [depth, S] global
+        psel = PendingSelection(
+            slots=slots[None],
+            scaled_probs=jnp.ones((1, depth, batch_size), jnp.float32),
+            rng=jax.random.key_data(sel_rng)[None],
+        )
+        new_state = state.replace(
+            stream=ShardStream(perm=stream.perm[None],
+                               cursor=stream.cursor[None]),
+            pending_sel=psel,
+        )
+        # [depth, 1, S]: stacked pushes, worker row sharded P(axis).
+        return new_state, gidx[:, None]
+
+    specs = _state_specs(
+        axis, zero_sharding=config.zero_sharding,
+        has_scoretable=use_scoretable, has_pending_sel=True,
+    )
+    sharded = shard_map(
+        prime,
+        mesh=mesh,
+        in_specs=(specs, P(axis)),
+        out_specs=(specs, P(None, axis)),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
 
 
 def make_eval_step(model) -> Callable[..., Tuple[jax.Array, jax.Array, jax.Array]]:

@@ -86,7 +86,10 @@ def build_dataset(config: TrainConfig, seed_offset: int = 0) -> ShardedDataset:
     return make_sharded_dataset(
         train, test, shards, info["mean"], info["std"], info["num_classes"],
         synthetic=info.get("synthetic", True),
-        device_resident=config.data_placement != "sharded",
+        # host_stream: pixels stay host numpy arrays — the prefetch
+        # pipeline streams selected rows; only labels go to device.
+        device_resident=config.data_placement not in ("sharded",
+                                                      "host_stream"),
     )
 
 
@@ -249,6 +252,10 @@ class Trainer:
                 config.use_importance_sampling
                 and config.sampler == "scoretable"
             ),
+            stream_depth=(config.prefetch_depth
+                          if config.data_placement == "host_stream" else 0),
+            stream_emit_size=self._stream_emit_size(),
+            stream_batch_size=config.batch_size,
         )
         params_sharded = tp > 1 or fs > 1
         if params_sharded:
@@ -387,7 +394,16 @@ class Trainer:
                 # globalize_state.)
                 state_sh, _ = self._state_out_shardings
                 self.state = jax.device_put(self.state, state_sh)
-        if not data_sharded:
+        host_stream = config.data_placement == "host_stream"
+        if host_stream:
+            # Pixels never become a step input: _step_x is the per-step
+            # streamed batch (popped from the prefetch pipeline in
+            # _host_stream_step). Labels are tiny ([N] int32) and the
+            # in-graph gathers index them, so they live on device.
+            self._step_x = None
+            self._step_y = jnp.asarray(np.asarray(self.dataset.y_train),
+                                       jnp.int32)
+        elif not data_sharded:
             self._step_x = self.dataset.x_train
             self._step_y = self.dataset.y_train
         self.train_step = make_train_step(
@@ -462,6 +478,51 @@ class Trainer:
         self._eval_cache: Dict[bool, tuple] = {}
         self._ckpt_thread = None  # in-flight async checkpoint write
 
+        # --- host-stream prefetch pipeline (data_placement="host_stream"):
+        # prime the in-graph selection ring with the first prefetch_depth
+        # draws (uniform cold start), then keep depth gathers in flight.
+        # Built BEFORE auto_resume: a restore re-seeds the ring and the
+        # pipeline via _recommit_state → _refill_stream_pipe.
+        self._stream_pipe = None
+        if host_stream:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "data_placement='host_stream' is single-controller "
+                    "only: the prefetch worker gathers from one host's "
+                    "copy of the dataset"
+                )
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            from mercury_tpu.data.stream import (
+                HostStreamSource,
+                PrefetchPipeline,
+            )
+            from mercury_tpu.train.step import make_host_stream_prime
+
+            source = HostStreamSource(
+                np.asarray(self.dataset.x_train),
+                decode_workers=config.decode_workers,
+            )
+            self._stream_x_sharding = NamedSharding(
+                self.mesh, P(config.mesh_axis)
+            )
+            self._stream_pipe = PrefetchPipeline(
+                source,
+                (config.world_size, self._stream_emit_size()),
+                self._stream_x_sharding,
+                depth=config.prefetch_depth,
+            )
+            self._stream_prime = make_host_stream_prime(config, self.mesh)
+            self.state, primed_gidx = self._stream_prime(
+                self.state, self.dataset.shard_indices
+            )
+            for i in range(config.prefetch_depth):
+                self._stream_pipe.push(primed_gidx[i])
+            # The streamed-x step has no host-side x template for the XLA
+            # cost model (analytic_flops_per_step reads _step_x); skip the
+            # lazy fill — mfu reports 0.0, steps/s and examples/s remain.
+            self._flops_known = True
+
         # Crash/preemption recovery: pick up the newest checkpoint, sampler
         # state included (bit-deterministic IS resume). The NEXT fit() then
         # runs to the ORIGINAL end step, not num_epochs more (see fit) —
@@ -511,6 +572,50 @@ class Trainer:
                               resumed)
                 self._auto_resumed = True
 
+    # -------------------------------------------------------- host streaming
+    def _stream_emit_size(self) -> int:
+        """Rows streamed per worker per step (mirrors ``make_train_step``):
+        the candidate pool for the pool sampler, refresh window + train
+        batch for the scoretable one, the batch itself for uniform."""
+        cfg = self.config
+        if cfg.use_importance_sampling and cfg.sampler == "scoretable":
+            return int(cfg.refresh_size) + int(cfg.batch_size)
+        if cfg.use_importance_sampling:
+            return int(cfg.candidate_pool_size)
+        return int(cfg.batch_size)
+
+    def _host_stream_step(self):
+        """One pop→step→push cycle: train on the oldest prefetched batch,
+        hand the step's emitted t+depth indices straight back to the
+        pipeline (still an in-flight device value — the worker thread
+        absorbs the sync)."""
+        batch = self._stream_pipe.pop()
+        self.state, metrics, next_gidx = self.train_step(
+            self.state, batch, self._step_y, self.dataset.shard_indices
+        )
+        self._stream_pipe.push(next_gidx)
+        return metrics
+
+    def _refill_stream_pipe(self) -> None:
+        """Re-seed the prefetch pipeline from ``state.pending_sel`` after a
+        checkpoint restore: every in-flight batch belongs to the previous
+        trajectory, but the restored ring's slots are exactly the
+        selections steps t..t+depth-1 will train on — push their global
+        rows so the pop→step→push cadence resumes unchanged."""
+        if getattr(self, "_stream_pipe", None) is None:
+            return
+        self._stream_pipe.reset()
+        # [W, depth, S] shard-local slots → global ids via the host copy
+        # of the shard index table.
+        slots = np.asarray(jax.device_get(self.state.pending_sel.slots))
+        shard_indices = np.asarray(self.dataset.shard_indices)
+        for d in range(slots.shape[1]):
+            gidx = np.stack([
+                shard_indices[w][slots[w, d]]
+                for w in range(slots.shape[0])
+            ])
+            self._stream_pipe.push(gidx)
+
     # ------------------------------------------------------------------ fit
     def fit(self, num_epochs: Optional[int] = None) -> Dict[str, float]:
         """Run training (``Trainer.fit``, ``pytorch_collab.py:56-72``).
@@ -545,7 +650,10 @@ class Trainer:
 
         try:
             while step < end:
-                if self.train_step_many is not None and step + self.scan_steps <= end:
+                if self._stream_pipe is not None:
+                    k = 1
+                    metrics = self._host_stream_step()
+                elif self.train_step_many is not None and step + self.scan_steps <= end:
                     k = self.scan_steps
                     self.state, metrics = self.train_step_many(
                         self.state,
@@ -586,6 +694,10 @@ class Trainer:
                     # outputs are not donated (only the state is).
                     record = dict(metrics)
                     record.update(self._throughput.tick(step))
+                    if self._stream_pipe is not None:
+                        # Host-side floats (stall/queue/bytes since the
+                        # last log): no device sync, safe to merge here.
+                        record.update(self._stream_pipe.stats())
                     record["epoch"] = (step - 1) // self.steps_per_epoch
                     self.logger.write(step, record)
                 if crossed(cfg.eval_every, step, k):
@@ -624,8 +736,11 @@ class Trainer:
         return final_metrics
 
     def close(self) -> None:
-        """Drain and close the metric writer (idempotent). A trainer also
-        works as a context manager: ``with Trainer(cfg) as t: t.fit()``."""
+        """Drain and close the metric writer and the prefetch pipeline
+        (idempotent). A trainer also works as a context manager:
+        ``with Trainer(cfg) as t: t.fit()``."""
+        if getattr(self, "_stream_pipe", None) is not None:
+            self._stream_pipe.close()
         self.logger.close()
 
     def __enter__(self) -> "Trainer":
@@ -654,7 +769,8 @@ class Trainer:
             # than committing a device-replicated full split.
             conv = (np.asarray
                     if jax.process_count() > 1
-                    or self.config.data_placement == "sharded"
+                    or self.config.data_placement in ("sharded",
+                                                      "host_stream")
                     else jnp.asarray)
             self._eval_cache[train] = (
                 conv(np.asarray(x)[idx]),
@@ -815,6 +931,7 @@ class Trainer:
                                  and cfg.score_refresh_every > 1),
                 has_scoretable=(cfg.use_importance_sampling
                                 and cfg.sampler == "scoretable"),
+                has_pending_sel=(cfg.data_placement == "host_stream"),
             )
         # Identity jit, not a bare device_put: on CPU device_put may
         # zero-copy alias the checkpoint reader's host buffers, and the
@@ -823,6 +940,9 @@ class Trainer:
         self.state = jax.jit(lambda s: s, out_shardings=state_sh)(
             jax.device_put(self.state, state_sh)
         )
+        # The restored pending_sel ring defines steps t..t+depth-1's
+        # selections; re-seed the prefetch pipeline with their rows.
+        self._refill_stream_pipe()
 
     def restore_elastic(self, directory: Optional[str] = None,
                         step: Optional[int] = None, raw=None) -> int:
@@ -835,6 +955,14 @@ class Trainer:
         (``pytorch_collab.py:291-292``)."""
         from mercury_tpu.train.elastic import elastic_restore
 
+        if self.config.data_placement == "host_stream":
+            raise ValueError(
+                "restore_elastic does not support host_stream: the "
+                "elastic path re-derives per-worker sampler state, which "
+                "would orphan the checkpointed pending_sel ring (the "
+                "in-flight selections are per-worker). Restore at the "
+                "original world size instead."
+            )
         directory = directory or self.config.checkpoint_dir
         assert directory, "no checkpoint directory configured"
         step = elastic_restore(directory, self, step, raw=raw)

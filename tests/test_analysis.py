@@ -135,6 +135,24 @@ class TestEstimateIsBenefit:
         np.testing.assert_allclose(r_is["ratio_is_loss"],
                                    r_uni["ratio_is_loss"], rtol=1e-6)
 
+    def test_probe_forces_float32_compute(self):
+        """A bf16-configured config gives the SAME probe result as its
+        f32 twin: the probe estimates variance RATIOS, and bf16 noise in
+        the per-sample losses would contaminate exactly the quantity
+        being measured (probe_cfg pins compute_dtype='float32')."""
+        base = dict(model="smallcnn", dataset="synthetic", world_size=1,
+                    batch_size=8, presample_batches=4, seed=0)
+        r_bf16 = estimate_is_benefit(
+            TrainConfig(compute_dtype="bfloat16", **base),
+            warm_steps=2, pools=2)
+        r_f32 = estimate_is_benefit(
+            TrainConfig(compute_dtype="float32", **base),
+            warm_steps=2, pools=2)
+        np.testing.assert_allclose(r_bf16["var_uniform"],
+                                   r_f32["var_uniform"], rtol=1e-6)
+        np.testing.assert_allclose(r_bf16["ratio_is_loss"],
+                                   r_f32["ratio_is_loss"], rtol=1e-6)
+
 
 class TestRecommend:
     def test_capped_regime(self):

@@ -1,10 +1,20 @@
-"""data_placement="sharded": each worker's shard rows materialized as
+"""Non-replicated data placements.
+
+``data_placement="sharded"``: each worker's shard rows materialized as
 [W, L, ...] arrays sharded over the data axis — per-device train-data
 memory is one shard row instead of the full dataset (the scaling-past-
 CIFAR path; parity with ``load_partition_data_distributed_cifar10``,
 ``cifar10/data_loader.py:214-245``). Must be numerically IDENTICAL to the
 replicated placement: the sharded gather x_shard[0][slots] reads the same
-bytes as the replicated x_train[shard_indices[0][slots]]."""
+bytes as the replicated x_train[shard_indices[0][slots]].
+
+``data_placement="host_stream"``: pixels never resident on device — the
+in-graph selection runs ``prefetch_depth`` steps ahead and a background
+thread streams each selected batch in (``data/stream.py``,
+``train/step.py::hs_body``). The uniform and pool samplers must be
+BIT-identical to replicated (the lookahead replays the same RNG chain);
+the scoretable sampler accepts depth-step-stale selection by design, so
+it gets a smoke + telemetry check instead."""
 
 import jax
 import numpy as np
@@ -14,12 +24,15 @@ from mercury_tpu.config import TrainConfig
 from mercury_tpu.parallel.mesh import host_cpu_mesh
 from mercury_tpu.train.trainer import Trainer
 
-pytestmark = pytest.mark.slow  # parallelism-matrix compile cost blows the tier-1 budget
-
 
 @pytest.fixture(scope="module")
 def mesh():
     return host_cpu_mesh(4)
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return host_cpu_mesh(1)
 
 
 def cfg(**kw):
@@ -40,7 +53,14 @@ def steps(tr, n):
     return out
 
 
+def stream_steps(tr, n):
+    return [float(tr._host_stream_step()["train/loss"]) for _ in range(n)]
+
+
 class TestShardedPlacement:
+    # parallelism-matrix compile cost blows the tier-1 budget
+    pytestmark = pytest.mark.slow
+
     def test_matches_replicated_bitwise(self, mesh):
         rep = Trainer(cfg(), mesh=mesh)
         shd = Trainer(cfg(data_placement="sharded"), mesh=mesh)
@@ -71,3 +91,126 @@ class TestShardedPlacement:
     def test_unknown_placement_rejected(self, mesh):
         with pytest.raises(ValueError, match="data_placement"):
             Trainer(cfg(data_placement="nope"), mesh=mesh)
+
+
+def hs_cfg(**kw):
+    base = dict(model="smallcnn", dataset="synthetic", world_size=1,
+                batch_size=8, presample_batches=2, steps_per_epoch=8,
+                num_epochs=1, eval_every=0, log_every=0, heartbeat_every=0,
+                checkpoint_every=0, compute_dtype="float32", seed=0)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+class TestHostStream:
+    """Tier-1: 1-device CPU mesh, small model — one compile per sampler."""
+
+    # ISSUE acceptance: loss-trajectory-identical for >= 3 steps after
+    # warmup. depth+4 = 6 steps covers cold-start AND steady state.
+    N_STEPS = 6
+
+    def _pair(self, mesh1, **kw):
+        rep = Trainer(hs_cfg(**kw), mesh=mesh1)
+        hs = Trainer(hs_cfg(data_placement="host_stream", prefetch_depth=2,
+                            **kw), mesh=mesh1)
+        return rep, hs
+
+    def test_uniform_bitwise_identical(self, mesh1):
+        rep, hs = self._pair(mesh1, use_importance_sampling=False)
+        try:
+            np.testing.assert_array_equal(
+                steps(rep, self.N_STEPS), stream_steps(hs, self.N_STEPS))
+        finally:
+            hs.close()
+
+    def test_pool_bitwise_identical(self, mesh1):
+        rep, hs = self._pair(mesh1)
+        try:
+            np.testing.assert_array_equal(
+                steps(rep, self.N_STEPS), stream_steps(hs, self.N_STEPS))
+        finally:
+            hs.close()
+
+    def test_scoretable_smoke_and_telemetry(self, mesh1):
+        hs = Trainer(hs_cfg(data_placement="host_stream", prefetch_depth=2,
+                            sampler="scoretable"), mesh=mesh1)
+        try:
+            losses = stream_steps(hs, self.N_STEPS)
+            assert np.all(np.isfinite(losses)), losses
+            stats = hs._stream_pipe.stats()
+            assert set(stats) == {"data/stall_s", "data/queue_depth",
+                                  "data/h2d_bytes"}
+            # 6 batches streamed: prime pushed 2, each step pushed 1 more.
+            assert stats["data/h2d_bytes"] > 0
+            assert hs._stream_pipe.pops == self.N_STEPS
+        finally:
+            hs.close()
+
+    def test_fit_streams_and_logs(self, mesh1):
+        hs = Trainer(hs_cfg(data_placement="host_stream", steps_per_epoch=3),
+                     mesh=mesh1)
+        try:
+            out = hs.fit(num_epochs=1)
+            assert np.isfinite(out["test/eval_loss"])
+            assert int(hs.state.step) == 3
+        finally:
+            hs.close()
+
+    @pytest.mark.parametrize("bad", [
+        dict(prefetch_depth=0),
+        dict(pipelined_scoring=True),
+        dict(score_refresh_every=2),
+        dict(sampler="groupwise"),
+        dict(scan_steps=3),
+    ])
+    def test_incompatible_configs_rejected(self, mesh1, bad):
+        with pytest.raises(ValueError):
+            Trainer(hs_cfg(data_placement="host_stream", **bad), mesh=mesh1)
+
+    def test_restore_elastic_rejected(self, mesh1, tmp_path):
+        hs = Trainer(hs_cfg(data_placement="host_stream",
+                            checkpoint_dir=str(tmp_path)), mesh=mesh1)
+        try:
+            with pytest.raises(ValueError, match="host_stream"):
+                hs.restore_elastic(str(tmp_path))
+        finally:
+            hs.close()
+
+
+class TestHostStreamMatrix:
+    """4-way parallelism matrix — compile cost belongs in the slow tier."""
+
+    pytestmark = pytest.mark.slow
+
+    @pytest.mark.parametrize("kw", [
+        dict(use_importance_sampling=False),
+        dict(),  # pool
+    ])
+    def test_w4_bitwise_identical(self, mesh, kw):
+        rep = Trainer(cfg(steps_per_epoch=8, **kw), mesh=mesh)
+        hs = Trainer(cfg(data_placement="host_stream", prefetch_depth=2,
+                         steps_per_epoch=8, **kw), mesh=mesh)
+        try:
+            np.testing.assert_array_equal(steps(rep, 6), stream_steps(hs, 6))
+        finally:
+            hs.close()
+
+    def test_w4_scoretable_runs(self, mesh):
+        hs = Trainer(cfg(data_placement="host_stream", prefetch_depth=2,
+                         sampler="scoretable", steps_per_epoch=8), mesh=mesh)
+        try:
+            losses = stream_steps(hs, 6)
+            assert np.all(np.isfinite(losses)), losses
+        finally:
+            hs.close()
+
+    def test_w4_depth3_uniform_identical(self, mesh):
+        rep = Trainer(cfg(steps_per_epoch=8,
+                          use_importance_sampling=False), mesh=mesh)
+        hs = Trainer(cfg(data_placement="host_stream", prefetch_depth=3,
+                         steps_per_epoch=8,
+                         use_importance_sampling=False), mesh=mesh)
+        try:
+            np.testing.assert_array_equal(steps(rep, 6), stream_steps(hs, 6))
+        finally:
+            hs.close()
