@@ -24,10 +24,23 @@ either it degrades to a scaled-down CPU run (marked ``"degraded": true``)
 so the round always captures an artifact.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+SLO gate (``--strict-stale``, default-on in CI via
+``MERCURY_BENCH_STRICT_STALE=1``): the resilience contract above always
+emits a record, which means a dead chip can hide behind a cached number
+forever. Strict mode turns that quiet degradation into a non-zero exit:
+a stale/degraded/failed record, a cached record older than
+``--max-stale-age-h``, or a real-chip MFU below ``--mfu-floor`` (the
+``TrainConfig.slo_mfu_floor`` default) exits rc 3 after printing the
+JSON line (with the violations attached). ``--stale-check-only``
+evaluates the committed ``bench_last_good.json`` without measuring —
+stdlib-only, no jax import, so CI can run the gate on machines with no
+accelerator stack.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -367,6 +380,98 @@ def _save_last_good(record: dict) -> None:
     os.replace(tmp, LAST_GOOD)
 
 
+# ------------------------------------------------------------- SLO gate
+#: Mirrors ``TrainConfig.slo_mfu_floor`` (config.py). A literal, not the
+#: import: the --stale-check-only path must stay stdlib-only (no jax).
+DEFAULT_MFU_FLOOR = 0.01
+DEFAULT_MAX_STALE_AGE_H = 72.0
+
+
+def slo_violations(record: dict | None,
+                   mfu_floor: float = DEFAULT_MFU_FLOOR,
+                   max_age_h: float = DEFAULT_MAX_STALE_AGE_H,
+                   now: float | None = None) -> list:
+    """Why this benchmark record fails the SLO gate (empty = healthy).
+
+    Pure stdlib, pure function of the record — unit-testable and usable
+    on the committed cache file without touching a backend. Checks, in
+    order: hard failure, degraded (CPU) protocol, explicit stale mark,
+    timestamp age beyond ``max_age_h``, and a real-chip MFU below
+    ``mfu_floor`` (CPU records carry mfu=None/0.0 — never judged)."""
+    out: list = []
+    if not record:
+        return ["no benchmark record (bench_last_good.json missing "
+                "or malformed)"]
+    if record.get("failed"):
+        out.append("record marks a failed measurement")
+    if record.get("degraded"):
+        out.append("degraded host-CPU protocol, not a real-chip result")
+    if record.get("stale"):
+        out.append("record explicitly marked stale "
+                   f"({record.get('stale_reason', 'no reason recorded')})")
+    ts = record.get("timestamp")
+    age_h = None
+    if ts:
+        try:
+            import calendar
+
+            age_s = ((now if now is not None else time.time())
+                     - calendar.timegm(
+                         time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")))
+            age_h = age_s / 3600.0
+        except Exception:
+            out.append(f"unparseable timestamp {ts!r}")
+    else:
+        out.append("record has no timestamp")
+    if age_h is not None and max_age_h > 0 and age_h > max_age_h:
+        out.append(f"record is {age_h:.1f}h old "
+                   f"(max_stale_age_h={max_age_h:g}) — no fresh "
+                   "real-chip measurement")
+    mfu = record.get("mfu")
+    if (record.get("platform") == "tpu" and mfu_floor > 0
+            and mfu is not None and mfu < mfu_floor):
+        out.append(f"mfu {mfu:g} below SLO floor {mfu_floor:g}")
+    return out
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--strict-stale", action="store_true",
+        default=bool(os.environ.get("MERCURY_BENCH_STRICT_STALE")),
+        help="exit 3 when the emitted record violates the SLO gate "
+             "(stale/degraded/failed/too-old/MFU floor); default on when "
+             "MERCURY_BENCH_STRICT_STALE is set (CI)")
+    p.add_argument(
+        "--stale-check-only", action="store_true",
+        help="evaluate bench_last_good.json against the SLO gate and "
+             "exit — no measurement, no jax import")
+    p.add_argument(
+        "--mfu-floor", type=float, default=DEFAULT_MFU_FLOOR,
+        help="minimum acceptable real-chip MFU "
+             "(default %(default)s, = TrainConfig.slo_mfu_floor)")
+    p.add_argument(
+        "--max-stale-age-h", type=float, default=DEFAULT_MAX_STALE_AGE_H,
+        help="maximum age of the record before it counts as stale "
+             "(default %(default)s h)")
+    return p.parse_args(argv)
+
+
+def _apply_slo_gate(record: dict | None, args) -> int:
+    """Attach violations to the record, report, and pick the exit code."""
+    violations = slo_violations(record, mfu_floor=args.mfu_floor,
+                                max_age_h=args.max_stale_age_h)
+    if record is not None and violations:
+        record["slo_violations"] = violations
+    for v in violations:
+        print(f"# SLO violation: {v}", file=sys.stderr)
+    if violations and args.strict_stale:
+        print(f"# SLO gate FAILED ({len(violations)} violation(s)); "
+              "exiting non-zero (--strict-stale)", file=sys.stderr)
+        return 3
+    return 0
+
+
 def _cpu_fallback_record() -> dict | None:
     """Measure on host CPU in a FRESH subprocess. In this process the
     (dead) platform backend may already be initialized, and
@@ -390,6 +495,21 @@ def _cpu_fallback_record() -> dict | None:
 
 
 def main():
+    args = _parse_args()
+
+    if args.stale_check_only:
+        # Gate-only mode: judge the committed cache, never touch a
+        # backend (this path must work on a jax-less CI runner).
+        record = _load_last_good()
+        if record is not None:
+            rc = _apply_slo_gate(record, args)
+            print(json.dumps(record))
+        else:
+            rc = _apply_slo_gate(None, args)
+            print(json.dumps({"metric": HEADLINE_METRIC, "failed": True,
+                              "slo_violations": ["no cached record"]}))
+        sys.exit(rc)
+
     # Persistent compile cache: scan-chunk compiles are minutes-long (and
     # on the real chip go over a flaky remote-compile tunnel) — cache them
     # across runs and across the timing/cost-analysis double compile.
@@ -460,7 +580,12 @@ def main():
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
 
+    # The SLO gate runs LAST, on whatever record the resilience ladder
+    # produced: the JSON line always prints (driver contract), strict
+    # mode additionally refuses to bless a stale/degraded/slow result.
+    rc = _apply_slo_gate(record, args)
     print(json.dumps(record))
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

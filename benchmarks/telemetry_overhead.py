@@ -12,6 +12,12 @@ The telemetry design claims two things:
    keys, no extra outputs, no dead ops left for XLA to clean up. This is
    checked structurally here (key set + lowered-text size), not assumed.
 
+The host span tracer (``obs/trace.py``) makes the same two-sided claim —
+enabled spans are single-digit µs, disabled call sites hit the shared
+no-op ``NULL_TRACER`` for ~100 ns — so its per-span cost is measured
+here too (``span_ns_*``), plus a ring-bound check (memory can't grow
+with run length).
+
 CPU-runnable (8 virtual devices, the test-harness platform) so the
 numbers regenerate anywhere::
 
@@ -109,6 +115,38 @@ class Arm:
         return r[len(r) // 2]
 
 
+def span_cost_ns(tracer, n: int = 200_000) -> float:
+    """Median-of-5 per-span cost of ``with tracer.span(...)`` — the
+    trainer hot-loop call-site shape (fixed name/cat, no args)."""
+    span = tracer.span
+    reps = []
+    for _ in range(5):
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            with span("bench/span", cat="bench"):
+                pass
+        reps.append((time.perf_counter_ns() - t0) / n)
+    return sorted(reps)[2]
+
+
+def measure_tracer() -> dict:
+    """Per-span cost, enabled vs disabled, plus the ring bound."""
+    from mercury_tpu.obs.trace import NULL_TRACER, SpanTracer
+
+    tracer = SpanTracer(capacity=4096)
+    enabled_ns = span_cost_ns(tracer)
+    disabled_ns = span_cost_ns(NULL_TRACER)
+    # Ring bound: 1M spans were recorded above, at most capacity retained.
+    assert len(tracer.snapshot()) <= tracer.capacity
+    assert tracer.dropped > 0
+    return {
+        "span_ns_enabled": round(enabled_ns, 1),
+        "span_ns_disabled": round(disabled_ns, 1),
+        "span_ring_capacity": tracer.capacity,
+        "span_ring_dropped": tracer.dropped,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="smallcnn")
@@ -139,6 +177,7 @@ def main(argv=None) -> int:
         off.lowered_lines, on.lowered_lines)
 
     overhead_pct = 100.0 * (off.steps_per_s / on.steps_per_s - 1.0)
+    tracer_cost = measure_tracer()
     record = {
         "schema": "telemetry_overhead_v1",
         "model": args.model,
@@ -160,6 +199,7 @@ def main(argv=None) -> int:
         "on_lowered_lines": on.lowered_lines,
         "off_lowered_lines": off.lowered_lines,
         "off_lowered_sha256": off.lowered_sha256,
+        **tracer_cost,
     }
     with open(args.out, "a") as f:
         f.write(json.dumps(record) + "\n")

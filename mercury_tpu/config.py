@@ -238,6 +238,56 @@ class TrainConfig:
     # Independent of log_every: metrics stream to JSONL/TensorBoard every
     # log_every steps, the terminal line appears every heartbeat_every.
     heartbeat_every: int = 100
+    # Host-side step-timeline tracing (obs/trace.py): record named spans
+    # around the trainer hot loop and the prefetch pipeline into a
+    # bounded ring; on close() the trace exports as Chrome-trace JSON
+    # (perfetto-loadable) next to the metrics. Host-only — the traced
+    # device program is identical either way; disabled call sites cost
+    # one shared no-op context manager (~100 ns, measured by
+    # benchmarks/telemetry_overhead.py).
+    trace: bool = False
+    # Span-ring capacity: the trace keeps the LAST trace_capacity spans
+    # (bounded memory for arbitrarily long runs); the same ring feeds
+    # the flight recorder's post-mortem span window.
+    trace_capacity: int = 4096
+    # Anomaly engine + flight recorder (obs/anomaly.py): evaluate health
+    # triggers continuously (non-finite loss/grad-norm, slow-step, ESS
+    # collapse, input-stall breach, MFU floor) and dump a self-contained
+    # flight_record_*.json on trigger. Value checks run on the metric
+    # writer's drain thread (log cadence, zero training-thread cost);
+    # the slow-step check is ~1 µs of host float math per step. Dumps
+    # land in anomaly_dir (default: log_dir); with neither set, triggers
+    # are detected and counted (anomaly/triggers) but nothing is
+    # written.
+    anomaly_detection: bool = True
+    anomaly_window: int = 64         # metric records kept in the ring
+    # slow_step trigger: step time > factor × rolling-median step time
+    # (armed after 16 samples so compiles don't false-positive); 0
+    # disables.
+    anomaly_slow_step_factor: float = 3.0
+    anomaly_cooldown_steps: int = 200  # min steps between flight dumps
+    # On trigger, arm jax.profiler for the next M steps (kernel-level
+    # trace into {anomaly_dir|log_dir}/profile). 0 disables.
+    anomaly_profile_steps: int = 0
+    anomaly_dir: Optional[str] = None  # flight-record dir; None → log_dir
+    # Fault injection for tests/CI ONLY: at the first log tick at or
+    # after this step, poison the HOST metric record's train/loss with
+    # NaN (the traced program is untouched) so the non_finite trigger
+    # path can be exercised end-to-end. 0 disables.
+    anomaly_inject_nan_step: int = 0
+    # --- SLOs: declarative health floors, evaluated continuously by the
+    # anomaly engine and shared with bench.py's --strict-stale gate.
+    # MFU floor (fraction of peak). Checked only when the device peak is
+    # known AND cost analysis produced FLOPs (never on CPU hosts). The
+    # committed TPU headline is 0.0185; 0.01 trips on a >~2x regression.
+    slo_mfu_floor: float = 0.01
+    # ESS floor for sampler/ess (0..1; 0 disables): below it the IS
+    # weight distribution has collapsed onto a few samples.
+    slo_ess_floor: float = 0.0
+    # host_stream: max input-attributable stall fraction of wall time
+    # per log interval (benchmarks budget is 0.10 steady-state; 0.25
+    # flags a sustained 2.5x breach). 0 disables.
+    slo_stall_frac_max: float = 0.25
     log_dir: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000     # steps; 0 disables

@@ -174,9 +174,15 @@ class PrefetchPipeline:
     """
 
     def __init__(self, source, batch_shape: Tuple[int, int], sharding,
-                 depth: int = 2, pop_timeout_s: float = 300.0) -> None:
+                 depth: int = 2, pop_timeout_s: float = 300.0,
+                 tracer=None) -> None:
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        if tracer is None:
+            from mercury_tpu.obs.trace import NULL_TRACER
+
+            tracer = NULL_TRACER
+        self._tracer = tracer
         self.source = source
         self.depth = int(depth)
         self._batch_shape = tuple(batch_shape)  # (W, S)
@@ -271,6 +277,20 @@ class PrefetchPipeline:
             "data/h2d_bytes": float(h2d),
         }
 
+    def summary(self) -> Dict[str, float]:
+        """Cumulative, NON-consuming counters (unlike :meth:`stats`,
+        which returns per-interval deltas and advances the interval
+        markers) — safe for out-of-band readers like flight-record
+        dumps."""
+        return {
+            "depth": float(self.depth),
+            "queue_depth": float(self._ready.qsize()),
+            "pops": float(self.pops),
+            "total_stall_s": self.total_stall_s,
+            "total_wait_s": self.total_wait_s,
+            "total_h2d_bytes": float(self.total_h2d_bytes),
+        }
+
     def reset(self) -> None:
         """Discard queued work and committed batches (checkpoint-restore
         refill: the restored ``pending_sel`` re-seeds the ring, so every
@@ -300,6 +320,8 @@ class PrefetchPipeline:
     def _prefetch_loop(self) -> None:
         import jax
 
+        tracer = self._tracer
+        tracer.register_thread("prefetch")
         while True:
             idx = self._work.get()
             if idx is _STOP:
@@ -314,17 +336,24 @@ class PrefetchPipeline:
                     # copy landed would corrupt that batch. depth+1 slabs
                     # back, the copy is all but certainly done — this is a
                     # fence, not a wait, and it bounds only this worker.
-                    prev.block_until_ready()  # graftlint: disable=GL114 -- staging-slab reuse fence; blocks only this worker
+                    with tracer.span("stream/slab_fence", cat="stream"):
+                        prev.block_until_ready()  # graftlint: disable=GL114 -- staging-slab reuse fence; blocks only this worker
                 # The one real sync this thread exists to absorb: idx is
                 # the step's in-flight index output, and materializing it
                 # here means the TRAINING thread never waits for it.
-                idx_h = np.asarray(idx)  # graftlint: disable=GL114 -- absorbing the index sync off the training thread is this worker's purpose
+                with tracer.span("stream/wait_indices", cat="stream"):
+                    idx_h = np.asarray(idx)  # graftlint: disable=GL114 -- absorbing the index sync off the training thread is this worker's purpose
                 t_ready = time.monotonic()
-                self.source.gather(
-                    idx_h.reshape(-1),
-                    staging.reshape((-1,) + tuple(self.source.row_shape)))
-                batch = jax.device_put(staging, self._sharding)
-                batch = self._commit(batch)
+                with tracer.span("stream/gather", cat="stream",
+                                 rows=int(idx_h.size)):
+                    self.source.gather(
+                        idx_h.reshape(-1),
+                        staging.reshape(
+                            (-1,) + tuple(self.source.row_shape)))
+                with tracer.span("stream/h2d", cat="stream",
+                                 bytes=int(staging.nbytes)):
+                    batch = jax.device_put(staging, self._sharding)
+                    batch = self._commit(batch)
                 self._inflight[slot] = batch
                 self.total_h2d_bytes += int(staging.nbytes)
                 # Published async: the commit is enqueued device work the

@@ -6,14 +6,17 @@ Layer selection:
 
 - ``--layer ast`` (default): Layer 1 over the given paths (default: the
   ``mercury_tpu`` package). Pure stdlib — never initializes jax.
+- ``--layer metrics``: Layer M — every ``category/name`` metric-key
+  literal in the package must exist in ``obs/registry.py::METRIC_KEYS``
+  and in the ``docs/API.md`` glossary. Pure stdlib, like Layer 1.
 - ``--layer audit``: Layer 2 — trace the parallelism-plan matrix on CPU
   and verify against the committed ``lint/budgets.json`` (``--regen`` to
   re-record it after an intentional program change).
 - ``--layer sharding``: Layer 3 — AOT-lower + compile each plan on the
   CPU mesh and verify the sharding/memory invariants against the
   committed ``lint/shard_budgets.json`` (``--regen`` parity).
-- ``--layer all``: all three. With ``--diff-out PATH`` the audit diff
-  goes to ``PATH`` and the sharding diff to ``PATH.sharding``.
+- ``--layer all``: all of the above. With ``--diff-out PATH`` the audit
+  diff goes to ``PATH`` and the sharding diff to ``PATH.sharding``.
 
 ``--json`` emits one document for every layer that ran::
 
@@ -50,7 +53,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="files/directories for Layer 1 (default: the "
                          "mercury_tpu package)")
-    ap.add_argument("--layer", choices=("ast", "audit", "sharding", "all"),
+    ap.add_argument("--layer",
+                    choices=("ast", "metrics", "audit", "sharding", "all"),
                     default="ast")
     ap.add_argument("--select", action="append", default=None,
                     metavar="RULE",
@@ -110,6 +114,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(format_findings(findings))
         if findings:
+            rc = 1
+
+    if args.layer in ("metrics", "all"):
+        from mercury_tpu.lint.metrics import run_metrics_check
+
+        try:
+            errors, warnings = run_metrics_check(paths=args.paths or None)
+        except (OSError, ValueError) as exc:
+            print(f"graftlint metrics: {exc}", file=sys.stderr)
+            return 2
+        if args.as_json:
+            collect("metrics", errors, warnings)
+        else:
+            for line in warnings:
+                print(f"warning: {line}")
+            for line in errors:
+                print(line)
+            if not errors:
+                print("graftlint metrics: emitted keys == registry == "
+                      "docs glossary")
+        if errors:
             rc = 1
 
     def _resolve_plans(known, what):

@@ -1,0 +1,172 @@
+"""graftlint Layer M: metric-key registry auditor (pure stdlib).
+
+Every metric tag the training path emits must exist in the central
+registry (``mercury_tpu/obs/registry.py::METRIC_KEYS``) and be
+documented in the ``docs/API.md`` metric-key glossary — otherwise
+dashboards silently accumulate unexplained streams and the glossary
+rots. This layer closes the loop statically:
+
+- **error** — a ``category/name`` string literal in the package that is
+  not a registered key (typo, or a new metric added without registering
+  and documenting it);
+- **error** — a registered key with no backticked mention in
+  ``docs/API.md`` (registered but undocumented);
+- **warning** — a registered key never seen as a literal in the package
+  (dead registry entry, or a key built only via f-strings — e.g. the
+  ``{train,test}/eval_*`` family, constructed from a split prefix).
+
+Like Layer 1 this never imports the package under lint (the registry is
+read by AST ``literal_eval`` of its source), so it runs on CI machines
+with no jax installed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+#: A metric tag: one of the registered categories, a slash, a snake_case
+#: name. Anything matching this shape in package source is treated as an
+#: emitted metric key and checked against the registry.
+KEY_RE = re.compile(
+    r"^(train|test|sampler|perf|time|data|obs|anomaly)/[a-z0-9_]+$")
+
+#: Backticked tokens in the docs, brace families included
+#: (``sampler/table_age_{min,mean,max}``). No newlines inside a token,
+#: and fenced ``` blocks are stripped first — a code fence would pair a
+#: stray backtick with the rest of the document.
+_DOC_TOKEN_RE = re.compile(r"`([^`\n]+)`")
+_FENCE_RE = re.compile(r"^```.*?^```[^\S\n]*$", re.M | re.S)
+_BRACE_RE = re.compile(r"\{([^{}]+)\}")
+
+#: Files whose key literals are definitional, not emissions.
+_SKIP_FILES = frozenset({"registry.py"})
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _default_registry_path() -> str:
+    return os.path.join(_repo_root(), "mercury_tpu", "obs", "registry.py")
+
+
+def _default_docs_path() -> str:
+    return os.path.join(_repo_root(), "docs", "API.md")
+
+
+def load_registry(path: str) -> Dict[str, str]:
+    """``METRIC_KEYS`` from the registry module's SOURCE — the dict is a
+    pure literal (enforced here by failing loudly if it is not), so no
+    import of the package (and thus no jax) is needed."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            targets = [node.target.id]
+        if "METRIC_KEYS" in targets and node.value is not None:
+            return ast.literal_eval(node.value)
+    raise ValueError(f"no METRIC_KEYS literal found in {path}")
+
+
+def _iter_py_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames) if f.endswith(".py"))
+    return out
+
+
+def emitted_keys(paths: List[str]) -> Dict[str, List[Tuple[str, int]]]:
+    """``key -> [(file, line), ...]`` for every plain string literal in
+    ``paths`` matching :data:`KEY_RE`. Constants inside f-strings are
+    skipped: a JoinedStr fragment is a key *prefix*, not a key, and
+    judging it would false-positive on every dynamic tag."""
+    found: Dict[str, List[Tuple[str, int]]] = {}
+    for path in _iter_py_files(paths):
+        if os.path.basename(path) in _SKIP_FILES:
+            continue
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            continue  # Layer 1 reports unparseable files
+        skip = {id(c) for node in ast.walk(tree)
+                if isinstance(node, ast.JoinedStr)
+                for c in ast.walk(node)}
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and id(node) not in skip
+                    and KEY_RE.match(node.value)):
+                found.setdefault(node.value, []).append(
+                    (path, node.lineno))
+    return found
+
+
+def documented_keys(docs_path: str) -> Set[str]:
+    """Keys mentioned in backticks anywhere in the docs file, with
+    ``{a,b,c}`` families expanded."""
+    with open(docs_path) as f:
+        text = _FENCE_RE.sub("", f.read())
+    keys: Set[str] = set()
+    for token in _DOC_TOKEN_RE.findall(text):
+        m = _BRACE_RE.search(token)
+        variants = ([_BRACE_RE.sub(alt, token, count=1)
+                     for alt in m.group(1).split(",")]
+                    if m else [token])
+        keys.update(v for v in variants if KEY_RE.match(v))
+    return keys
+
+
+def run_metrics_check(paths: List[str] = None,
+                      registry_path: str = None,
+                      docs_path: str = None
+                      ) -> Tuple[List[str], List[str]]:
+    """The Layer M audit; returns ``(errors, warnings)`` of formatted
+    finding lines (the Layer 2/3 CLI contract)."""
+    registry_path = registry_path or _default_registry_path()
+    docs_path = docs_path or _default_docs_path()
+    if not paths:
+        paths = [os.path.join(_repo_root(), "mercury_tpu")]
+    registry = load_registry(registry_path)
+    emitted = emitted_keys(paths)
+    documented = documented_keys(docs_path)
+
+    errors: List[str] = []
+    warnings: List[str] = []
+    root = _repo_root()
+    for key in sorted(emitted):
+        if key not in registry:
+            f, line = emitted[key][0]
+            errors.append(
+                f"{os.path.relpath(f, root)}:{line}: GLM01 metric key "
+                f"{key!r} is not in obs/registry.py::METRIC_KEYS "
+                f"({len(emitted[key])} use(s)) — register and document "
+                "it, or fix the typo")
+    for key in sorted(registry):
+        if key not in documented:
+            errors.append(
+                f"{os.path.relpath(docs_path, root)}: GLM02 registered "
+                f"metric key {key!r} has no backticked entry in the "
+                "docs — add it to the metric-key glossary")
+        if key not in emitted:
+            warnings.append(
+                f"GLM03 registered metric key {key!r} never appears as "
+                "a literal in the package (f-string-built or dead "
+                "entry)")
+    return errors, warnings

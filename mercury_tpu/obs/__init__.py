@@ -14,8 +14,32 @@ Three layers (see ``docs/DESIGN.md`` §15):
 3. :mod:`~mercury_tpu.obs.manifest` / :mod:`~mercury_tpu.obs.accounting`
    — the run manifest written at trainer start, and live steps/s /
    examples/s / MFU on the log cadence.
+4. :mod:`~mercury_tpu.obs.trace` / :mod:`~mercury_tpu.obs.anomaly` —
+   layer 2 (``docs/OBSERVABILITY.md``): the ring-buffered host span
+   tracer (Chrome-trace/Perfetto export) and the flight recorder +
+   anomaly engine (non-finite loss, slow-step, ESS collapse, stall
+   breach, MFU floor → ``flight_record_*.json`` + optional on-demand
+   profiler capture).
+5. :mod:`~mercury_tpu.obs.registry` — the central metric-key registry;
+   every tag the training path emits must be listed there (enforced by
+   ``python -m mercury_tpu.lint --layer metrics``).
 """
 
+from mercury_tpu.obs.anomaly import (
+    FLIGHT_RECORD_SCHEMA,
+    AnomalyEngine,
+    device_memory_stats,
+)
+from mercury_tpu.obs.registry import (
+    METRIC_KEYS,
+    RECORD_FIELDS,
+    is_registered,
+)
+from mercury_tpu.obs.trace import (
+    NULL_TRACER,
+    NullTracer,
+    SpanTracer,
+)
 from mercury_tpu.obs.accounting import (
     PEAK_FLOPS,
     ThroughputMeter,
@@ -44,6 +68,15 @@ from mercury_tpu.obs.writer import (
 )
 
 __all__ = [
+    "FLIGHT_RECORD_SCHEMA",
+    "AnomalyEngine",
+    "device_memory_stats",
+    "METRIC_KEYS",
+    "RECORD_FIELDS",
+    "is_registered",
+    "NULL_TRACER",
+    "NullTracer",
+    "SpanTracer",
     "PEAK_FLOPS",
     "ThroughputMeter",
     "analytic_flops_per_step",

@@ -1,0 +1,293 @@
+"""Flight recorder + anomaly engine: post-mortem state for runs that die.
+
+A rolling ring of the last N logged steps' metric records (host floats,
+captured on the :class:`~mercury_tpu.obs.writer.AsyncMetricWriter` drain
+thread — zero training-thread cost) plus the span tracer's ring, dumped
+as one self-contained ``flight_record_*.json`` the moment a health
+trigger fires:
+
+- **non_finite** — ``train/loss`` or ``train/grad_norm`` is NaN/Inf.
+  The training path has no NaN sentinel of its own (a diverged run
+  happily trains garbage forever); this is it.
+- **slow_step** — a step took more than ``slow_step_factor`` × the
+  rolling-median step time (fed per step by the trainer; host floats
+  only). Armed only once the median window has filled, so compile
+  steps and cold starts don't false-positive.
+- **ess_collapse** — ``sampler/ess`` fell below the SLO floor: the IS
+  weight distribution degenerated and the estimator variance is blowing
+  up (the operational reading of arXiv:1511.06481's score freshness).
+- **stall_breach** — host_stream input stall fraction over the log
+  interval exceeded its SLO budget: the overlap design is not hiding
+  the input path any more.
+- **mfu_floor** — measured MFU fell below the SLO floor (evaluated only
+  when the device peak is known, i.e. never on CPU hosts).
+
+On trigger the engine dumps the flight record (ring, spans, config,
+manifest, pipeline/pending-selection summary, device memory stats) and —
+when ``profile_steps`` > 0 — arms an on-demand ``jax.profiler`` capture
+window that the trainer opens for the next M steps, so the *next*
+occurrence of a sporadic anomaly is captured at kernel granularity.
+
+Triggers are debounced (``cooldown_steps`` between dumps, ``max_dumps``
+per run) and counted: the cumulative count rides on every subsequent
+metric record as ``anomaly/triggers`` (heartbeat-visible). When no dump
+directory is configured the engine still detects and counts, it just
+keeps no files.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.obs.anomaly")
+
+#: Schema tag for ``flight_record_*.json``; bump on shape changes.
+FLIGHT_RECORD_SCHEMA = "mercury_flight_record_v1"
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-local-device allocator stats (``bytes_in_use`` etc.), empty
+    when the backend exposes none (CPU). Never raises."""
+    out: Dict[str, Dict[str, int]] = {}
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                out[f"{d.platform}:{d.id}"] = {
+                    k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float))
+                }
+    except Exception:
+        pass
+    return out
+
+
+class AnomalyEngine:
+    """Continuous health evaluation + flight-record dumps.
+
+    Two feed points, on two different threads:
+
+    - :meth:`observe_step_time` — trainer thread, once per step: cheap
+      float bookkeeping for the slow-step trigger. ~1 µs.
+    - :meth:`observe_record` — metric-writer drain thread, once per
+      logged record: rings the record, checks the value-based triggers,
+      attaches ``anomaly/triggers``. Registered as a writer observer by
+      the trainer, so it costs the training thread nothing.
+
+    ``context_fn`` supplies the dump's run context (config, manifest,
+    pipeline summary) lazily — evaluated only when a trigger actually
+    fires."""
+
+    #: Step-time samples required before slow_step arms (compile /
+    #: cold-start steps would otherwise seed a garbage median).
+    MIN_STEP_SAMPLES = 16
+
+    def __init__(
+        self,
+        *,
+        ring_steps: int = 64,
+        slow_step_factor: float = 3.0,
+        ess_floor: float = 0.0,
+        stall_frac_max: float = 0.0,
+        mfu_floor: float = 0.0,
+        cooldown_steps: int = 200,
+        max_dumps: int = 8,
+        dump_dir: Optional[str] = None,
+        tracer=None,
+        context_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        profile_steps: int = 0,
+    ) -> None:
+        if ring_steps < 1:
+            raise ValueError(f"ring_steps must be >= 1, got {ring_steps}")
+        self.ring: deque = deque(maxlen=int(ring_steps))
+        self.slow_step_factor = float(slow_step_factor)
+        self.ess_floor = float(ess_floor)
+        self.stall_frac_max = float(stall_frac_max)
+        self.mfu_floor = float(mfu_floor)
+        self.cooldown_steps = int(cooldown_steps)
+        self.max_dumps = int(max_dumps)
+        self.dump_dir = dump_dir
+        self.tracer = tracer
+        self.context_fn = context_fn
+        self.profile_steps = int(profile_steps)
+
+        self.triggers = 0
+        self.trigger_counts: Dict[str, int] = {}
+        self.dumps: List[str] = []
+        self._last_trigger_step: Optional[int] = None
+        self._lock = threading.Lock()
+
+        # Slow-step state (trainer thread only).
+        self._step_times: deque = deque(maxlen=128)
+        self._median_s: Optional[float] = None
+        self._since_median = 0
+
+        # Stall-fraction state (drain thread only).
+        self._prev_record_time: Optional[float] = None
+
+        # Profiler arming (set under the lock, consumed by the trainer).
+        self._profile_pending = 0
+
+    # ----------------------------------------------------- trainer thread
+    def observe_step_time(self, step: int, dt_s: float,
+                          steps: int = 1) -> None:
+        """One loop iteration's wall time (``steps`` > 1 for scanned
+        chunks — the per-step time is the mean). Host floats only."""
+        per_step = dt_s / max(int(steps), 1)
+        self._step_times.append(per_step)
+        self._since_median += 1
+        # Median refresh is amortized: every 16 appends, or whenever the
+        # cache is cold. statistics.median over <=128 floats is ~10 µs;
+        # at one refresh per 16 steps it vanishes.
+        if self._median_s is None or self._since_median >= 16:
+            if len(self._step_times) >= self.MIN_STEP_SAMPLES:
+                self._median_s = statistics.median(self._step_times)
+            self._since_median = 0
+        if (
+            self.slow_step_factor > 0
+            and self._median_s is not None
+            and len(self._step_times) >= self.MIN_STEP_SAMPLES
+            and per_step > self.slow_step_factor * self._median_s
+        ):
+            self._trigger(
+                "slow_step", step,
+                {"step_time_s": per_step,
+                 "rolling_median_s": self._median_s,
+                 "factor": per_step / max(self._median_s, 1e-12)},
+            )
+
+    def take_profile_request(self) -> int:
+        """Steps of ``jax.profiler`` capture requested by the latest
+        trigger; clears the request. Trainer-polled once per step."""
+        if not self._profile_pending:
+            return 0
+        with self._lock:
+            n, self._profile_pending = self._profile_pending, 0
+        return n
+
+    # ------------------------------------------------------- drain thread
+    def observe_record(self, record: Dict[str, float]) -> None:
+        """Ring one host metric record and evaluate the value-based
+        triggers. Mutates ``record`` to attach ``anomaly/triggers``
+        (the writer observer contract) once any trigger has fired."""
+        step = int(record.get("step", -1))
+        self.ring.append(dict(record))
+
+        for key in ("train/loss", "train/grad_norm"):
+            v = record.get(key)
+            if v is not None and not math.isfinite(v):
+                self._trigger("non_finite", step, {"key": key, "value": v})
+                break
+
+        ess = record.get("sampler/ess")
+        if self.ess_floor > 0 and ess is not None and ess < self.ess_floor:
+            self._trigger("ess_collapse", step,
+                          {"ess": ess, "floor": self.ess_floor})
+
+        stall = record.get("data/stall_s")
+        now = record.get("time")
+        if stall is not None and now is not None:
+            prev = self._prev_record_time
+            self._prev_record_time = now
+            if (self.stall_frac_max > 0 and prev is not None
+                    and now > prev):
+                frac = stall / (now - prev)
+                if frac > self.stall_frac_max:
+                    self._trigger(
+                        "stall_breach", step,
+                        {"stall_frac": frac,
+                         "budget": self.stall_frac_max},
+                    )
+
+        mfu = record.get("perf/mfu")
+        # mfu == 0.0 means "peak unknown" (CPU hosts) — not a breach.
+        if self.mfu_floor > 0 and mfu and mfu < self.mfu_floor:
+            self._trigger("mfu_floor", step,
+                          {"mfu": mfu, "floor": self.mfu_floor})
+
+        if self.triggers:
+            record["anomaly/triggers"] = float(self.triggers)
+
+    # ----------------------------------------------------------- triggering
+    def _trigger(self, kind: str, step: int,
+                 detail: Dict[str, Any]) -> None:
+        with self._lock:
+            self.triggers += 1
+            self.trigger_counts[kind] = self.trigger_counts.get(kind, 0) + 1
+            last = self._last_trigger_step
+            debounced = (
+                last is not None
+                and step >= 0
+                and step - last < self.cooldown_steps
+            ) or len(self.dumps) >= self.max_dumps
+            if not debounced:
+                self._last_trigger_step = step
+                if self.profile_steps > 0:
+                    self._profile_pending = self.profile_steps
+        _log.warning("anomaly trigger %s at step %d: %s", kind, step, detail)
+        if self.tracer is not None:
+            self.tracer.instant(f"anomaly/{kind}", cat="anomaly", step=step)
+        if debounced:
+            return
+        path = self.dump_flight_record(kind, step, detail)
+        if path:
+            _log.warning("flight record written: %s", path)
+
+    def dump_flight_record(self, kind: str, step: int,
+                           detail: Optional[Dict[str, Any]] = None
+                           ) -> Optional[str]:
+        """Write the self-contained post-mortem JSON; returns its path,
+        or None when no dump directory is configured. Never raises —
+        a failed dump must not take the run down with it."""
+        if not self.dump_dir:
+            return None
+        try:
+            doc: Dict[str, Any] = {
+                "schema": FLIGHT_RECORD_SCHEMA,
+                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+                "trigger": {"kind": kind, "step": int(step),
+                            "detail": detail or {}},
+                "trigger_counts": dict(self.trigger_counts),
+                "triggers_total": self.triggers,
+                "ring": list(self.ring),
+                "spans": (self.tracer.snapshot()
+                          if self.tracer is not None else []),
+                "step_time_window_s": [round(t, 6)
+                                       for t in self._step_times],
+                "rolling_median_step_s": self._median_s,
+                "device_memory": device_memory_stats(),
+            }
+            if self.context_fn is not None:
+                try:
+                    doc.update(self.context_fn())
+                except Exception as exc:
+                    doc["context_error"] = f"{type(exc).__name__}: {exc}"
+            os.makedirs(self.dump_dir, exist_ok=True)
+            name = f"flight_record_step{max(step, 0)}_{kind}.json"
+            path = os.path.join(self.dump_dir, name)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, default=str)
+                f.write("\n")
+            os.replace(tmp, path)
+            self.dumps.append(path)
+            return path
+        except Exception as exc:
+            _log.warning("flight-record dump failed: %s: %s",
+                         type(exc).__name__, exc)
+            return None

@@ -1,0 +1,65 @@
+"""Central metric-key registry: every tag the training path may emit.
+
+One flat ``key → one-line meaning`` dict, stdlib-only (graftlint's
+metric-key layer AST-parses this file without importing jax — keep it a
+pure literal plus trivial helpers). The registry is the contract between
+the emitters (``train/step.py``, ``train/trainer.py``, ``data/stream.py``,
+``obs/*``) and the consumers (sinks, dashboards, the anomaly engine,
+``docs/API.md``'s glossary): a key that is not here is a lint error, so a
+renamed or fat-fingered metric fails CI instead of silently forking the
+stream (``python -m mercury_tpu.lint --layer metrics``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: Metric tags proper — ``prefix/name``, one row per scalar in the
+#: stream. Grouped families (``sampler/table_age_{min,mean,max}``) are
+#: spelled out: the registry is exact-match, expansion lives in docs.
+METRIC_KEYS: Dict[str, str] = {
+    # train/* — the step's own scalars
+    "train/loss": "selected-batch reweighted loss (chunk mean under scan)",
+    "train/acc": "selected-batch accuracy",
+    "train/pool_loss": "mean score over the candidate pool",
+    "train/sparse_rate": "gradient-compression sparsity (0 when off)",
+    "train/moe_aux": "MoE load-balancing aux loss (0 when off)",
+    "train/grad_norm": "global L2 norm of the post-allreduce gradient",
+    "train/eval_loss": "train-split eval loss (inference mode)",
+    "train/eval_acc": "train-split eval accuracy (inference mode)",
+    # test/* — eval pass over the held-out split
+    "test/eval_loss": "test-split eval loss (inference mode)",
+    "test/eval_acc": "test-split eval accuracy (inference mode)",
+    # sampler/* — importance-sampling health (telemetry=True only)
+    "sampler/ess": "normalized effective sample size of the IS weights",
+    "sampler/clip_frac": "fraction of candidate scores at/below the floor",
+    "sampler/ema_drift": "fresh score mean minus pre-update EMA",
+    "sampler/table_age_min": "scoretable: youngest entry age (sweeps)",
+    "sampler/table_age_mean": "scoretable: mean entry age (sweeps)",
+    "sampler/table_age_max": "scoretable: oldest entry age (sweeps)",
+    # perf/* — throughput accounting between log ticks
+    "perf/steps_per_s": "steps per second since the previous log tick",
+    "perf/examples_per_s": "examples per second since the previous log tick",
+    "perf/flops_per_step": "XLA cost-analysis FLOPs of the fused step",
+    "perf/mfu": "model FLOPs utilization against the device peak",
+    # time/* — legacy aliases kept for dashboard continuity
+    "time/step": "seconds per step (legacy alias)",
+    "time/images_per_sec": "examples per second (legacy alias)",
+    # data/* — host_stream input pipeline
+    "data/stall_s": "input-attributable pop() wait since the last log tick",
+    "data/queue_depth": "committed prefetch batches ready at log time",
+    "data/h2d_bytes": "staged host-to-device bytes since the last log tick",
+    # obs/* — the metric stream observing itself
+    "obs/dropped": "cumulative records dropped by the bounded queue",
+    # anomaly/* — flight-recorder health accounting
+    "anomaly/triggers": "cumulative anomaly triggers fired this run",
+}
+
+#: Bookkeeping fields that ride along in every record but are not metric
+#: tags (no ``prefix/`` namespace, never plotted as series of their own).
+RECORD_FIELDS = ("step", "time", "epoch")
+
+
+def is_registered(key: str) -> bool:
+    """True when ``key`` is a known metric tag or bookkeeping field."""
+    return key in METRIC_KEYS or key in RECORD_FIELDS

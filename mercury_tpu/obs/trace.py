@@ -1,0 +1,215 @@
+"""Host-side step-timeline tracing: where the wall-clock actually went.
+
+The fused XLA step is opaque from the host, but everything *around* it —
+prefetch pop waits, host gathers, H2D commits, dispatch, eval,
+checkpoint writes, metric drains — is host code, and that is exactly
+where Mercury's overlap claims live or die. :class:`SpanTracer` records
+named spans from any thread into a fixed-capacity ring (steady-state
+memory and cost are bounded regardless of run length) and exports them
+as Chrome trace-event JSON, loadable directly in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``.
+
+Overhead discipline (measured by ``benchmarks/telemetry_overhead.py``):
+
+- **enabled**: one ``perf_counter_ns`` pair + a deque append per span —
+  single-digit microseconds, invisible next to a training step;
+- **disabled**: :data:`NULL_TRACER` returns one shared no-op context
+  manager, so an instrumented call site costs an attribute lookup and
+  two empty method calls (~100 ns) and allocates nothing. The traced
+  device program is untouched either way — tracing is host-only.
+
+Span schema (one Chrome ``"ph": "X"`` complete event per span)::
+
+    {"name": "stream/gather", "cat": "stream", "ph": "X",
+     "ts": <µs since tracer epoch>, "dur": <µs>,
+     "pid": <os pid>, "tid": <thread id>, "args": {...}}
+
+``docs/OBSERVABILITY.md`` documents the schema and the fixed span
+vocabulary the trainer and prefetch pipeline emit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SpanTracer", "NULL_TRACER", "NullTracer"]
+
+
+class _NullSpan:
+    """Shared reusable no-op context manager — the entire disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Disabled tracer: same surface as :class:`SpanTracer`, no state.
+
+    Call sites keep their instrumentation unconditionally and pay only
+    the shared no-op context manager when tracing is off — no branches
+    at the call site, no per-span allocation."""
+
+    enabled = False
+
+    def span(self, name: str, cat: str = "trainer", **args) -> _NullSpan:
+        return _NULL_SPAN
+
+    def instant(self, name: str, cat: str = "trainer", **args) -> None:
+        return None
+
+    def register_thread(self, name: str) -> None:
+        return None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return []
+
+    def export_chrome_trace(self, path: str) -> Optional[str]:
+        return None
+
+
+#: The process-wide disabled tracer. ``tracer or NULL_TRACER`` is the
+#: idiom for optional-tracer parameters.
+NULL_TRACER = NullTracer()
+
+
+class _Span:
+    """One live span: measures ``perf_counter_ns`` across the body and
+    appends a ring tuple on exit. Exceptions propagate (the span still
+    records — a span that died mid-body is exactly what a post-mortem
+    wants to see)."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, cat: str,
+                 args: Optional[Dict[str, Any]]) -> None:
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter_ns()
+        tr = self._tracer
+        # deque.append is atomic under the GIL: spans land from the
+        # training thread, the prefetch worker, and the metric drain
+        # thread without a lock on the hot path.
+        tr._ring.append((self._name, self._cat, threading.get_ident(),
+                         self._t0, t1 - self._t0, self._args))
+        tr._total += 1
+        return False
+
+
+class SpanTracer:
+    """Ring-buffered host span tracer with Chrome-trace export.
+
+    ``capacity`` bounds memory and export size: a week-long run keeps
+    the *last* ``capacity`` spans (the flight recorder's post-mortem
+    window), and ``dropped`` says how many rotated out."""
+
+    enabled = True
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._total = 0
+        self._epoch_ns = time.perf_counter_ns()
+        self._epoch_unix = time.time()
+        self._thread_names: Dict[int, str] = {}
+
+    # ------------------------------------------------------------ recording
+    def span(self, name: str, cat: str = "trainer", **args) -> _Span:
+        """Context manager timing its body as one complete event."""
+        return _Span(self, name, cat, args or None)
+
+    def instant(self, name: str, cat: str = "trainer", **args) -> None:
+        """Zero-duration marker event (trigger points, mode switches)."""
+        self._ring.append((name, cat, threading.get_ident(),
+                           time.perf_counter_ns(), -1, args or None))
+        self._total += 1
+
+    def register_thread(self, name: str) -> None:
+        """Name the calling thread in the exported trace's track list."""
+        self._thread_names[threading.get_ident()] = name
+
+    @property
+    def dropped(self) -> int:
+        """Spans rotated out of the ring since construction."""
+        return self._total - len(self._ring)
+
+    # -------------------------------------------------------------- export
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Ring contents as Chrome trace events (oldest first). A point-
+        in-time copy — safe while other threads keep recording."""
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = []
+        for name, cat, tid, t0_ns, dur_ns, args in list(self._ring):
+            ev: Dict[str, Any] = {
+                "name": name,
+                "cat": cat,
+                "ts": (t0_ns - self._epoch_ns) / 1e3,  # µs, tracer epoch
+                "pid": pid,
+                "tid": tid,
+            }
+            if dur_ns < 0:
+                ev["ph"] = "i"
+                ev["s"] = "t"  # instant scoped to its thread
+            else:
+                ev["ph"] = "X"
+                ev["dur"] = dur_ns / 1e3
+            if args:
+                ev["args"] = dict(args)
+            events.append(ev)
+        return events
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The full trace document: events + thread-name metadata."""
+        pid = os.getpid()
+        events = self.snapshot()
+        for tid, name in list(self._thread_names.items()):
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": name},
+            })
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tracer": "mercury_tpu.obs.trace",
+                "epoch_unix_s": self._epoch_unix,
+                "span_capacity": self.capacity,
+                "spans_recorded": self._total,
+                "spans_dropped": self.dropped,
+            },
+        }
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the trace JSON atomically; returns the path. The file
+        loads as-is in Perfetto / ``chrome://tracing``."""
+        doc = self.chrome_trace()
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path

@@ -70,13 +70,20 @@ class AsyncMetricWriter:
     nothing). ``start=False`` disables that entirely — records queue and
     only :meth:`flush`/:meth:`close` drain them, synchronously
     (deterministic unit testing of the queue policy).
+
+    ``observers`` are callables invoked with each HOST record (after
+    device_get, before the sinks) on the drain thread — the anomaly
+    engine's feed point. An observer may mutate the record in place
+    (e.g. attach ``anomaly/triggers``) and the sinks see the mutation;
+    observer exceptions are counted (``.errors``), never raised.
     """
 
     def __init__(self, sinks: Iterable, capacity: int = 256,
-                 start: bool = True) -> None:
+                 start: bool = True, observers: Iterable = ()) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.sinks = [s for s in sinks if s is not None]
+        self.observers = [o for o in observers if o is not None]
         self.capacity = capacity
         self.dropped = 0
         self.errors = 0
@@ -175,6 +182,13 @@ class AsyncMetricWriter:
             _log.warning("metric record for step %d failed on host "
                          "conversion: %s", step, exc)
             return
+        for ob in self.observers:
+            try:
+                ob(record)
+            except Exception as exc:
+                self.errors += 1
+                _log.warning("observer %r failed at step %d: %s",
+                             ob, step, exc)
         for s in self.sinks:
             try:
                 s.write(record)
@@ -291,7 +305,7 @@ class HeartbeatSink:
 
     _KEYS = ("train/loss", "train/acc", "perf/steps_per_s",
              "perf/examples_per_s", "perf/mfu", "sampler/ess",
-             "data/stall_s")
+             "data/stall_s", "obs/dropped", "anomaly/triggers")
 
     def __init__(self, every_steps: int = 100, min_interval_s: float = 1.0,
                  stream=None) -> None:

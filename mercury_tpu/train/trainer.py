@@ -30,7 +30,10 @@ Parity map:
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import dataclasses
+import os
+import time
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +45,9 @@ from mercury_tpu.data.partition import partition_data
 from mercury_tpu.data.pipeline import ShardedDataset, eval_batches, make_sharded_dataset
 from mercury_tpu.models import create_model
 from mercury_tpu.obs.accounting import ThroughputMeter, analytic_flops_per_step
-from mercury_tpu.obs.manifest import write_run_manifest
+from mercury_tpu.obs.anomaly import AnomalyEngine
+from mercury_tpu.obs.manifest import build_run_manifest, write_run_manifest
+from mercury_tpu.obs.trace import NULL_TRACER, SpanTracer
 from mercury_tpu.obs.writer import (
     AsyncMetricWriter,
     HeartbeatSink,
@@ -463,7 +468,41 @@ class Trainer:
             sinks.append(try_tensorboard_sink(config.log_dir))
         if config.heartbeat_every and jax.process_index() == 0:
             sinks.append(HeartbeatSink(every_steps=config.heartbeat_every))
-        self.logger = AsyncMetricWriter(sinks)
+        # --- step-timeline tracer + flight recorder (obs layer 2) ---
+        # Disabled tracing is the shared no-op NULL_TRACER: every span
+        # call site below stays unconditional and costs ~100 ns
+        # (benchmarks/telemetry_overhead.py measures both arms). The
+        # anomaly engine's value checks ride the writer's drain thread
+        # as an observer; only the ~1 µs slow-step bookkeeping runs on
+        # this thread.
+        self.tracer = (SpanTracer(config.trace_capacity)
+                       if config.trace else NULL_TRACER)
+        self.anomaly: Optional[AnomalyEngine] = None
+        if config.anomaly_detection and jax.process_index() == 0:
+            self.anomaly = AnomalyEngine(
+                ring_steps=config.anomaly_window,
+                slow_step_factor=config.anomaly_slow_step_factor,
+                ess_floor=config.slo_ess_floor,
+                stall_frac_max=(config.slo_stall_frac_max
+                                if config.data_placement == "host_stream"
+                                else 0.0),
+                mfu_floor=config.slo_mfu_floor,
+                cooldown_steps=config.anomaly_cooldown_steps,
+                dump_dir=config.anomaly_dir or config.log_dir,
+                tracer=self.tracer,
+                context_fn=self._flight_context,
+                profile_steps=config.anomaly_profile_steps,
+            )
+        self.logger = AsyncMetricWriter(
+            sinks,
+            observers=((self.anomaly.observe_record,)
+                       if self.anomaly is not None else ()),
+        )
+        # On-demand jax.profiler capture window: >0 means "this many more
+        # steps, then stop_trace" (armed by an anomaly trigger).
+        self._profile_steps_left = 0
+        self._profiling = False
+        self._nan_injected = False
         # steps/s, examples/s, MFU between log ticks; the analytic FLOPs
         # estimate is filled in lazily at the first log gate (the step has
         # compiled by then, so lower().compile() is a jit-cache hit).
@@ -511,6 +550,7 @@ class Trainer:
                 (config.world_size, self._stream_emit_size()),
                 self._stream_x_sharding,
                 depth=config.prefetch_depth,
+                tracer=self.tracer,
             )
             self._stream_prime = make_host_stream_prime(config, self.mesh)
             self.state, primed_gidx = self._stream_prime(
@@ -589,11 +629,17 @@ class Trainer:
         hand the step's emitted t+depth indices straight back to the
         pipeline (still an in-flight device value — the worker thread
         absorbs the sync)."""
-        batch = self._stream_pipe.pop()
-        self.state, metrics, next_gidx = self.train_step(
-            self.state, batch, self._step_y, self.dataset.shard_indices
-        )
-        self._stream_pipe.push(next_gidx)
+        # pop blocks only when the prefetch worker fell behind — the
+        # span IS the input-stall (its wall time, minus µs of queue
+        # bookkeeping, is time the trainer waited on data).
+        with self.tracer.span("trainer/pop", cat="trainer"):
+            batch = self._stream_pipe.pop()
+        with self.tracer.span("trainer/dispatch", cat="trainer"):
+            self.state, metrics, next_gidx = self.train_step(
+                self.state, batch, self._step_y, self.dataset.shard_indices
+            )
+        with self.tracer.span("trainer/push", cat="trainer"):
+            self._stream_pipe.push(next_gidx)
         return metrics
 
     def _refill_stream_pipe(self) -> None:
@@ -604,17 +650,31 @@ class Trainer:
         rows so the pop→step→push cadence resumes unchanged."""
         if getattr(self, "_stream_pipe", None) is None:
             return
-        self._stream_pipe.reset()
-        # [W, depth, S] shard-local slots → global ids via the host copy
-        # of the shard index table.
-        slots = np.asarray(jax.device_get(self.state.pending_sel.slots))
-        shard_indices = np.asarray(self.dataset.shard_indices)
-        for d in range(slots.shape[1]):
-            gidx = np.stack([
-                shard_indices[w][slots[w, d]]
-                for w in range(slots.shape[0])
-            ])
-            self._stream_pipe.push(gidx)
+        with self.tracer.span("trainer/refill_stream_pipe", cat="trainer"):
+            self._stream_pipe.reset()
+            # [W, depth, S] shard-local slots → global ids via the host
+            # copy of the shard index table.
+            slots = np.asarray(jax.device_get(self.state.pending_sel.slots))
+            shard_indices = np.asarray(self.dataset.shard_indices)
+            for d in range(slots.shape[1]):
+                gidx = np.stack([
+                    shard_indices[w][slots[w, d]]
+                    for w in range(slots.shape[0])
+                ])
+                self._stream_pipe.push(gidx)
+
+    # ---------------------------------------------------------- flight data
+    def _flight_context(self) -> Dict[str, Any]:
+        """Run context for flight-record dumps (obs/anomaly.py) —
+        evaluated lazily, only when a trigger actually fires."""
+        ctx: Dict[str, Any] = {
+            "config": dataclasses.asdict(self.config),
+            "manifest": build_run_manifest(self.config, self.mesh),
+        }
+        pipe = getattr(self, "_stream_pipe", None)
+        if pipe is not None:
+            ctx["pipeline"] = pipe.summary()
+        return ctx
 
     # ------------------------------------------------------------------ fit
     def fit(self, num_epochs: Optional[int] = None) -> Dict[str, float]:
@@ -648,28 +708,52 @@ class Trainer:
             """Did [at-advanced, at] cross a multiple of ``every``?"""
             return bool(every) and (at // every) > ((at - advanced) // every)
 
+        self.tracer.register_thread("train")
         try:
             while step < end:
+                # Wall time of the whole training action: under async
+                # dispatch each iteration converges to the true device
+                # step cadence once the dispatch queue applies
+                # backpressure — exactly the signal slow_step wants.
+                t_iter = time.perf_counter()
                 if self._stream_pipe is not None:
                     k = 1
                     metrics = self._host_stream_step()
                 elif self.train_step_many is not None and step + self.scan_steps <= end:
                     k = self.scan_steps
-                    self.state, metrics = self.train_step_many(
-                        self.state,
-                        self._step_x,
-                        self._step_y,
-                        self.dataset.shard_indices,
-                    )
+                    with self.tracer.span("trainer/dispatch",
+                                          cat="trainer", steps=k):
+                        self.state, metrics = self.train_step_many(
+                            self.state,
+                            self._step_x,
+                            self._step_y,
+                            self.dataset.shard_indices,
+                        )
                 else:
                     k = 1
-                    self.state, metrics = self.train_step(
-                        self.state,
-                        self._step_x,
-                        self._step_y,
-                        self.dataset.shard_indices,
-                    )
+                    with self.tracer.span("trainer/dispatch", cat="trainer"):
+                        self.state, metrics = self.train_step(
+                            self.state,
+                            self._step_x,
+                            self._step_y,
+                            self.dataset.shard_indices,
+                        )
                 step += k
+                if self.anomaly is not None:
+                    self.anomaly.observe_step_time(
+                        step, time.perf_counter() - t_iter, steps=k)
+                # On-demand profiler window: an anomaly trigger arms M
+                # steps of jax.profiler capture; open it here (next
+                # occurrence of a sporadic anomaly lands inside it) and
+                # close it M steps later.
+                if self._profile_steps_left > 0:
+                    self._profile_steps_left -= k
+                    if self._profile_steps_left <= 0:
+                        self._stop_profiler()
+                elif self.anomaly is not None:
+                    want = self.anomaly.take_profile_request()
+                    if want > 0:
+                        self._start_profiler(want)
                 if crossed(cfg.log_every, step, k):
                     if not self._flops_known:
                         # First log gate: ask XLA's cost model for the
@@ -692,32 +776,48 @@ class Trainer:
                     # last entry would discard (K-1)/K of the signal) —
                     # obs/writer.py:_to_host_record. Safe to hold: metric
                     # outputs are not donated (only the state is).
-                    record = dict(metrics)
-                    record.update(self._throughput.tick(step))
-                    if self._stream_pipe is not None:
-                        # Host-side floats (stall/queue/bytes since the
-                        # last log): no device sync, safe to merge here.
-                        record.update(self._stream_pipe.stats())
-                    record["epoch"] = (step - 1) // self.steps_per_epoch
-                    self.logger.write(step, record)
+                    with self.tracer.span("trainer/log_gate",
+                                          cat="trainer", step=step):
+                        record = dict(metrics)
+                        record.update(self._throughput.tick(step))
+                        if self._stream_pipe is not None:
+                            # Host-side floats (stall/queue/bytes since
+                            # the last log): no device sync, safe to
+                            # merge here.
+                            record.update(self._stream_pipe.stats())
+                        record["epoch"] = (step - 1) // self.steps_per_epoch
+                        # Fault injection (tests/CI): poison the HOST
+                        # record so the non_finite trigger path runs
+                        # end-to-end; the traced program is untouched.
+                        if (cfg.anomaly_inject_nan_step
+                                and not self._nan_injected
+                                and step >= cfg.anomaly_inject_nan_step):
+                            record["train/loss"] = float("nan")
+                            self._nan_injected = True
+                        self.logger.write(step, record)
                 if crossed(cfg.eval_every, step, k):
-                    final_metrics = self.evaluate()
+                    with self.tracer.span("trainer/eval", cat="trainer",
+                                          step=step):
+                        final_metrics = self.evaluate()
                     self.logger.log_scalars(step, final_metrics)
                     print(
                         f"  eval @ {step}: "
                         + " ".join(f"{k}={v:.4f}" for k, v in final_metrics.items())
                     )
                 if cfg.checkpoint_dir and crossed(cfg.checkpoint_every, step, k):
-                    if cfg.async_checkpoint:
-                        # One in-flight write at a time: join the previous
-                        # before fetching the next snapshot.
-                        if self._ckpt_thread is not None:
-                            self._ckpt_thread.join()
-                        self._ckpt_thread = ckpt.save_checkpoint_async(
-                            cfg.checkpoint_dir, self.state, step
-                        )
-                    else:
-                        ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
+                    with self.tracer.span("trainer/checkpoint",
+                                          cat="trainer", step=step):
+                        if cfg.async_checkpoint:
+                            # One in-flight write at a time: join the
+                            # previous before fetching the next snapshot.
+                            if self._ckpt_thread is not None:
+                                self._ckpt_thread.join()
+                            self._ckpt_thread = ckpt.save_checkpoint_async(
+                                cfg.checkpoint_dir, self.state, step
+                            )
+                        else:
+                            ckpt.save_checkpoint(cfg.checkpoint_dir,
+                                                 self.state, step)
         finally:
             # An exception mid-loop (KeyboardInterrupt, eval error) must not
             # leave a write in flight — a relaunched auto_resume reading a
@@ -735,12 +835,53 @@ class Trainer:
             ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
         return final_metrics
 
+    # ------------------------------------------------- profiler window
+    def _start_profiler(self, steps: int) -> None:
+        """Open a ``jax.profiler`` capture for the next ``steps`` steps
+        (anomaly-armed). Never raises — profiling is best-effort."""
+        logdir = self.config.anomaly_dir or self.config.log_dir
+        if not logdir or self._profiling:
+            return
+        path = os.path.join(logdir, "profile")
+        try:
+            jax.profiler.start_trace(path)
+        except Exception as exc:
+            _log.warning("profiler start failed: %s", exc)
+            return
+        self._profiling = True
+        self._profile_steps_left = int(steps)
+        self.tracer.instant("profiler/start", cat="trainer", steps=steps)
+        _log.warning("anomaly-armed profiler capture: %d steps -> %s",
+                     steps, path)
+
+    def _stop_profiler(self) -> None:
+        if not self._profiling:
+            return
+        self._profiling = False
+        self._profile_steps_left = 0
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            _log.warning("profiler stop failed: %s", exc)
+        self.tracer.instant("profiler/stop", cat="trainer")
+
     def close(self) -> None:
-        """Drain and close the metric writer and the prefetch pipeline
+        """Drain and close the metric writer and the prefetch pipeline,
+        stop any armed profiler capture, and export the span trace
         (idempotent). A trainer also works as a context manager:
         ``with Trainer(cfg) as t: t.fit()``."""
         if getattr(self, "_stream_pipe", None) is not None:
             self._stream_pipe.close()
+        if getattr(self, "_profiling", False):
+            self._stop_profiler()
+        tracer = getattr(self, "tracer", None)
+        if (tracer is not None and tracer.enabled and self.config.log_dir
+                and jax.process_index() == 0):
+            try:
+                tracer.export_chrome_trace(
+                    os.path.join(self.config.log_dir, "trace.json"))
+            except Exception as exc:
+                _log.warning("trace export failed: %s", exc)
         self.logger.close()
 
     def __enter__(self) -> "Trainer":

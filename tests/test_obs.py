@@ -221,6 +221,91 @@ class TestAsyncMetricWriter:
         assert len(w.sinks) == 1
         w.close()
 
+    def test_close_racing_inflight_drain_loses_nothing(self):
+        # close() while the drain thread is mid-queue: every record
+        # written before close() must reach the sink exactly once —
+        # close drains the queue after joining the thread, and the two
+        # paths must not double-emit. A slow sink keeps the race window
+        # open for real.
+        import time as _time
+
+        class SlowSink(ListSink):
+            def write(self, record):
+                _time.sleep(0.002)
+                super().write(record)
+
+        sink = SlowSink()
+        w = AsyncMetricWriter([sink])
+        for step in range(1, 21):
+            w.write(step, {"v": step})
+        w.close()  # thread mid-drain: ~40 ms of sink work is queued
+        assert [r["step"] for r in sink.records] == list(range(1, 21))
+        assert sink.closed == 1
+
+    def test_wedged_sink_drops_oldest_not_training(self):
+        # A sink that blocks forever on its first write (wedged NFS /
+        # TB): write() must keep returning instantly, the bounded queue
+        # must rotate (drop-OLDEST), and close() must come back despite
+        # the thread being stuck inside the sink.
+        release = threading.Event()
+
+        class WedgedSink(ListSink):
+            def write(self, record):
+                release.wait(timeout=30.0)
+                super().write(record)
+
+        import time as _time
+
+        sink = WedgedSink()
+        w = AsyncMetricWriter([sink], capacity=4)
+        w.write(1, {"v": 1})
+        # Wait until the drain thread has TAKEN record 1 (it is now
+        # wedged inside the sink), so the drop accounting below is
+        # deterministic rather than racing thread startup.
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            with w._lock:
+                if not w._q and w._busy:
+                    break
+            _time.sleep(0.001)
+        for step in range(2, 11):
+            w.write(step, {"v": step})  # returns instantly every time
+        # 1 record wedged in the sink, 4 queued (7..10), 2..6 dropped.
+        assert w.dropped == 5
+        release.set()
+        w.close()
+        # The wedged record plus the queue's newest survivors landed,
+        # in order, exactly once; survivors carry the drop count.
+        assert [r["step"] for r in sink.records] == [1, 7, 8, 9, 10]
+        assert sink.records[-1]["obs/dropped"] == 5.0
+
+    def test_observer_sees_host_record_and_mutation_reaches_sinks(self):
+        sink = ListSink()
+        seen = []
+
+        def observer(record):
+            seen.append(dict(record))
+            record["anomaly/triggers"] = 1.0  # may mutate in place
+
+        w = AsyncMetricWriter([sink], start=False, observers=(observer,))
+        w.write(3, {"train/loss": jnp.asarray(2.0)})
+        w.flush()
+        assert seen[0]["train/loss"] == 2.0  # host float, post device_get
+        assert sink.records[0]["anomaly/triggers"] == 1.0
+
+    def test_observer_exception_is_counted_not_raised(self):
+        sink = ListSink()
+
+        def bad(record):
+            raise RuntimeError("observer down")
+
+        w = AsyncMetricWriter([sink, None], start=False,
+                              observers=(bad, None))
+        w.write(1, {"v": 1.0})
+        w.flush()
+        assert [r["step"] for r in sink.records] == [1]
+        assert w.errors == 1
+
 
 class TestJsonlSink:
     def test_buffered_writes_land_on_close(self, tmp_path):
@@ -246,6 +331,22 @@ class TestHeartbeatSink:
         assert lines[0].startswith("step 1")
         assert [l.split()[1] for l in lines] == ["1", "2", "4", "6"]
         assert "ess 0.9" in lines[0]
+
+    def test_optional_keys_absent_and_present(self):
+        # Non-host_stream runs have no data/stall_s; pre-trigger runs
+        # have no anomaly/triggers — the line simply omits them, and
+        # grows the fields once the keys appear.
+        out = io.StringIO()
+        hb = HeartbeatSink(every_steps=1, min_interval_s=0.0, stream=out)
+        hb.write({"step": 1, "train/loss": 1.0})
+        hb.write({"step": 2, "train/loss": 0.9, "data/stall_s": 0.25,
+                  "obs/dropped": 3.0, "anomaly/triggers": 2.0})
+        first, second = out.getvalue().splitlines()
+        assert "stall_s" not in first and "triggers" not in first
+        assert first == "step 1  loss 1"
+        assert "stall_s 0.25" in second
+        assert "dropped 3" in second
+        assert "triggers 2" in second
 
 
 # -------------------------------------------------------------- accounting
