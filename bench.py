@@ -454,7 +454,43 @@ def _parse_args(argv=None):
         "--max-stale-age-h", type=float, default=DEFAULT_MAX_STALE_AGE_H,
         help="maximum age of the record before it counts as stale "
              "(default %(default)s h)")
+    p.add_argument(
+        "--profile-breakdown",
+        default=os.environ.get("MERCURY_BENCH_BREAKDOWN", ""),
+        help="path to a device_time_breakdown.json (obs.profile_parse "
+             "output) to attach to the emitted record; default "
+             "$MERCURY_BENCH_BREAKDOWN, else ./device_time_breakdown.json "
+             "when present")
     return p.parse_args(argv)
+
+
+def _attach_breakdown(record: dict, path: str) -> None:
+    """Fold an ``obs.profile_parse`` breakdown into the bench record
+    (scope fractions + overlap/idle summaries), best-effort and
+    stdlib-only: a bad or missing file never sinks the bench line."""
+    if not path:
+        candidate = os.path.join(os.getcwd(), "device_time_breakdown.json")
+        path = candidate if os.path.exists(candidate) else ""
+    if not path:
+        return
+    try:
+        with open(path) as f:
+            bd = json.load(f)
+        if not str(bd.get("schema", "")).startswith(
+                "mercury_device_time_breakdown"):
+            raise ValueError(f"unrecognized schema {bd.get('schema')!r}")
+        record["device_time_breakdown"] = {
+            "source": bd.get("source"),
+            "total_device_time_us": bd.get("total_device_time_us"),
+            "attributed_frac": bd.get("attributed_frac"),
+            "scope_frac": {name: stats.get("frac")
+                           for name, stats in bd.get("scopes", {}).items()},
+            "h2d_overlap_frac": bd.get("h2d", {}).get("overlap_frac"),
+            "idle_frac": bd.get("idle", {}).get("idle_frac"),
+        }
+    except Exception as e:
+        print(f"# profile breakdown not attached ({path}): "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
 
 
 def _apply_slo_gate(record: dict | None, args) -> int:
@@ -579,6 +615,8 @@ def main():
             "failed": True,
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
+
+    _attach_breakdown(record, args.profile_breakdown)
 
     # The SLO gate runs LAST, on whatever record the resilience ladder
     # produced: the JSON line always prints (driver contract), strict

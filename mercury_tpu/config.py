@@ -288,6 +288,20 @@ class TrainConfig:
     # per log interval (benchmarks budget is 0.10 steady-state; 0.25
     # flags a sustained 2.5x breach). 0 disables.
     slo_stall_frac_max: float = 0.25
+    # --- cross-host telemetry (obs/aggregate.py): merge per-host metric
+    # shards into host/{min,max,spread}/* + host/straggler_ratio on
+    # host 0's records. "auto" → "files" when process_count > 1, off
+    # otherwise. "files" tails the metrics.h{p}.jsonl shards on the
+    # writer's drain thread (needs a log_dir shared across hosts);
+    # "allgather" runs a small dedicated jitted gather on the log
+    # cadence instead (no shared filesystem needed — the fused step is
+    # never touched, so Layer-2/3 digests are identical either way).
+    crosshost_telemetry: str = "auto"   # auto | off | files | allgather
+    # Rolling per-host step-time window behind host/straggler_ratio.
+    crosshost_window: int = 8
+    # straggler trigger: max/median per-host step time above this factor
+    # fires the flight recorder (multi-process only; 0 disables).
+    anomaly_straggler_factor: float = 2.0
     log_dir: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000     # steps; 0 disables

@@ -28,10 +28,13 @@ import re
 from typing import Dict, List, Set, Tuple
 
 #: A metric tag: one of the registered categories, a slash, a snake_case
-#: name. Anything matching this shape in package source is treated as an
-#: emitted metric key and checked against the registry.
+#: name — optionally one more ``/segment`` (the ``host/{min,max,spread}/*``
+#: and ``prof/scope_frac/*`` families are two levels deep). Anything
+#: matching this shape in package source is treated as an emitted metric
+#: key and checked against the registry.
 KEY_RE = re.compile(
-    r"^(train|test|sampler|perf|time|data|obs|anomaly)/[a-z0-9_]+$")
+    r"^(train|test|sampler|perf|time|data|obs|anomaly|host|prof)"
+    r"/[a-z0-9_]+(/[a-z0-9_]+)?$")
 
 #: Backticked tokens in the docs, brace families included
 #: (``sampler/table_age_{min,mean,max}``). No newlines inside a token,

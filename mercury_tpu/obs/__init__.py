@@ -1,7 +1,7 @@
 """Telemetry subsystem: in-graph sampler-health diagnostics, non-blocking
 metric streaming, and run accounting.
 
-Three layers (see ``docs/DESIGN.md`` §15):
+Layers (see ``docs/DESIGN.md`` §15 and ``docs/OBSERVABILITY.md``):
 
 1. :mod:`~mercury_tpu.obs.diagnostics` — device-computed health scalars
    (ESS, clip rate, EMA drift, score-table staleness, grad norm) emitted
@@ -10,7 +10,7 @@ Three layers (see ``docs/DESIGN.md`` §15):
 2. :mod:`~mercury_tpu.obs.writer` — :class:`AsyncMetricWriter`: bounded
    queue + background drain thread, drop-oldest with a counted
    ``dropped`` stat, fan-out to JSONL / TensorBoard / stdout-heartbeat
-   sinks.
+   sinks, plus per-host shard sinks (``metrics.h{p}.jsonl``).
 3. :mod:`~mercury_tpu.obs.manifest` / :mod:`~mercury_tpu.obs.accounting`
    — the run manifest written at trainer start, and live steps/s /
    examples/s / MFU on the log cadence.
@@ -18,81 +18,139 @@ Three layers (see ``docs/DESIGN.md`` §15):
    layer 2 (``docs/OBSERVABILITY.md``): the ring-buffered host span
    tracer (Chrome-trace/Perfetto export) and the flight recorder +
    anomaly engine (non-finite loss, slow-step, ESS collapse, stall
-   breach, MFU floor → ``flight_record_*.json`` + optional on-demand
-   profiler capture).
+   breach, MFU floor, cross-host straggler → ``flight_record_*.json``
+   + optional on-demand profiler capture).
 5. :mod:`~mercury_tpu.obs.registry` — the central metric-key registry;
    every tag the training path emits must be listed there (enforced by
    ``python -m mercury_tpu.lint --layer metrics``).
+6. :mod:`~mercury_tpu.obs.aggregate` / :mod:`~mercury_tpu.obs.profile_parse`
+   / :mod:`~mercury_tpu.obs.report` — layer 3: cross-host shard
+   aggregation (``host/*`` metrics + straggler detection), offline
+   device-time attribution of profiler captures, and the run-report /
+   regression CLI (``python -m mercury_tpu.obs.report``).
+
+Imports here are LAZY (PEP 562): ``mercury_tpu.obs.report`` and
+``mercury_tpu.obs.profile_parse`` are offline tools that must run on
+machines with no jax installed, so importing this package must not pull
+:mod:`~mercury_tpu.obs.diagnostics` (which imports jax at module level).
+``from mercury_tpu.obs import AsyncMetricWriter`` still works — the
+submodule loads on first attribute access.
 """
 
-from mercury_tpu.obs.anomaly import (
-    FLIGHT_RECORD_SCHEMA,
-    AnomalyEngine,
-    device_memory_stats,
-)
-from mercury_tpu.obs.registry import (
-    METRIC_KEYS,
-    RECORD_FIELDS,
-    is_registered,
-)
-from mercury_tpu.obs.trace import (
-    NULL_TRACER,
-    NullTracer,
-    SpanTracer,
-)
-from mercury_tpu.obs.accounting import (
-    PEAK_FLOPS,
-    ThroughputMeter,
-    analytic_flops_per_step,
-    peak_flops,
-)
-from mercury_tpu.obs.diagnostics import (
-    clip_fraction,
-    ema_drift,
-    ess_fraction,
-    global_grad_norm,
-    table_age_summary,
-    table_ages,
-)
-from mercury_tpu.obs.manifest import (
-    build_run_manifest,
-    git_revision,
-    write_run_manifest,
-)
-from mercury_tpu.obs.writer import (
-    AsyncMetricWriter,
-    HeartbeatSink,
-    JsonlSink,
-    TensorBoardSink,
-    try_tensorboard_sink,
-)
+import importlib
+from typing import TYPE_CHECKING
 
-__all__ = [
-    "FLIGHT_RECORD_SCHEMA",
-    "AnomalyEngine",
-    "device_memory_stats",
-    "METRIC_KEYS",
-    "RECORD_FIELDS",
-    "is_registered",
-    "NULL_TRACER",
-    "NullTracer",
-    "SpanTracer",
-    "PEAK_FLOPS",
-    "ThroughputMeter",
-    "analytic_flops_per_step",
-    "peak_flops",
-    "clip_fraction",
-    "ema_drift",
-    "ess_fraction",
-    "global_grad_norm",
-    "table_age_summary",
-    "table_ages",
-    "build_run_manifest",
-    "git_revision",
-    "write_run_manifest",
-    "AsyncMetricWriter",
-    "HeartbeatSink",
-    "JsonlSink",
-    "TensorBoardSink",
-    "try_tensorboard_sink",
-]
+#: public name -> defining submodule (relative). The eager star-imports
+#: this replaces pulled jax into every consumer of the stdlib-only parts.
+_LAZY_ATTRS = {
+    "FLIGHT_RECORD_SCHEMA": "anomaly",
+    "AnomalyEngine": "anomaly",
+    "device_memory_stats": "anomaly",
+    "METRIC_KEYS": "registry",
+    "RECORD_FIELDS": "registry",
+    "is_registered": "registry",
+    "NULL_TRACER": "trace",
+    "NullTracer": "trace",
+    "SpanTracer": "trace",
+    "PEAK_FLOPS": "accounting",
+    "ThroughputMeter": "accounting",
+    "analytic_flops_per_step": "accounting",
+    "peak_flops": "accounting",
+    "clip_fraction": "diagnostics",
+    "ema_drift": "diagnostics",
+    "ess_fraction": "diagnostics",
+    "global_grad_norm": "diagnostics",
+    "table_age_summary": "diagnostics",
+    "table_ages": "diagnostics",
+    "build_run_manifest": "manifest",
+    "git_revision": "manifest",
+    "write_run_manifest": "manifest",
+    "AsyncMetricWriter": "writer",
+    "HeartbeatSink": "writer",
+    "HeartbeatShardSink": "writer",
+    "JsonlSink": "writer",
+    "TensorBoardSink": "writer",
+    "try_tensorboard_sink": "writer",
+    "HostShardAggregator": "aggregate",
+    "StragglerWindow": "aggregate",
+    "merge_host_stats": "aggregate",
+    "BREAKDOWN_SCHEMA": "profile_parse",
+    "attribute_device_time": "profile_parse",
+    "parse_profile": "profile_parse",
+    "scope_frac_metrics": "profile_parse",
+    "write_breakdown": "profile_parse",
+}
+
+__all__ = sorted(_LAZY_ATTRS)
+
+
+def __getattr__(name: str):
+    module = _LAZY_ATTRS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(
+        importlib.import_module(f"{__name__}.{module}"), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))
+
+
+if TYPE_CHECKING:  # static analyzers see the real names
+    from mercury_tpu.obs.aggregate import (  # noqa: F401
+        HostShardAggregator,
+        StragglerWindow,
+        merge_host_stats,
+    )
+    from mercury_tpu.obs.anomaly import (  # noqa: F401
+        FLIGHT_RECORD_SCHEMA,
+        AnomalyEngine,
+        device_memory_stats,
+    )
+    from mercury_tpu.obs.accounting import (  # noqa: F401
+        PEAK_FLOPS,
+        ThroughputMeter,
+        analytic_flops_per_step,
+        peak_flops,
+    )
+    from mercury_tpu.obs.diagnostics import (  # noqa: F401
+        clip_fraction,
+        ema_drift,
+        ess_fraction,
+        global_grad_norm,
+        table_age_summary,
+        table_ages,
+    )
+    from mercury_tpu.obs.manifest import (  # noqa: F401
+        build_run_manifest,
+        git_revision,
+        write_run_manifest,
+    )
+    from mercury_tpu.obs.profile_parse import (  # noqa: F401
+        BREAKDOWN_SCHEMA,
+        attribute_device_time,
+        parse_profile,
+        scope_frac_metrics,
+        write_breakdown,
+    )
+    from mercury_tpu.obs.registry import (  # noqa: F401
+        METRIC_KEYS,
+        RECORD_FIELDS,
+        is_registered,
+    )
+    from mercury_tpu.obs.trace import (  # noqa: F401
+        NULL_TRACER,
+        NullTracer,
+        SpanTracer,
+    )
+    from mercury_tpu.obs.writer import (  # noqa: F401
+        AsyncMetricWriter,
+        HeartbeatShardSink,
+        HeartbeatSink,
+        JsonlSink,
+        TensorBoardSink,
+        try_tensorboard_sink,
+    )

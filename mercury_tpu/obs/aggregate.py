@@ -1,0 +1,299 @@
+"""Cross-host telemetry aggregation: merge per-host metric shards.
+
+Every process writes its own ``metrics.h{process_index}.jsonl`` shard
+(obs/writer.py sinks, wired unconditionally by the trainer — non-zero
+hosts used to be completely dark). This module turns those shards back
+into one cross-host view on host 0:
+
+- :class:`HostShardAggregator` — registered as an
+  :class:`~mercury_tpu.obs.writer.AsyncMetricWriter` *observer*, so it
+  rides the existing drain thread: each time host 0 logs a record, the
+  aggregator incrementally tails every shard file (byte offsets are
+  remembered — each pass reads only what appeared since the last), takes
+  each host's latest ``time/step`` / ``data/stall_s`` /
+  ``data/queue_depth``, and attaches ``host/{min,max,spread}/*`` plus
+  ``host/straggler_ratio`` to the record in flight. File-based, so it
+  needs no collective, no barrier, and works even when a host is wedged
+  (its shard just stops advancing — visible as a stale ``step``).
+
+- :class:`StragglerWindow` — rolling per-host step-time window; the
+  straggler signal is ``max(host mean) / median(host mean)`` over the
+  window, which the anomaly engine checks against
+  ``anomaly_straggler_factor`` (trigger kind ``straggler``).
+
+- :func:`allgather_host_stats` — the in-graph fallback for filesystems
+  that are NOT shared across hosts: a tiny *separate* jitted
+  ``process_allgather`` program on the log cadence. Because it is its
+  own program (never part of the fused step), the step's Layer-2/3
+  jaxpr/HLO digests are identical whether the flag is on or off.
+
+Everything except :func:`allgather_host_stats` is stdlib-only, so the
+offline report CLI can reuse the merge math without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import statistics
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.obs.aggregate")
+
+#: Shard filename for one host's metric stream.
+SHARD_PATTERN = re.compile(r"^metrics\.h(\d+)\.jsonl$")
+
+
+def shard_filename(process_index: int) -> str:
+    return f"metrics.h{int(process_index)}.jsonl"
+
+
+def heartbeat_shard_filename(process_index: int) -> str:
+    return f"heartbeat.h{int(process_index)}.jsonl"
+
+
+#: Per-host source key -> the ``host/{min,max,spread}`` keys it merges
+#: into. Pure literals: graftlint Layer M audits emitted keys by AST.
+AGG_KEYS: Dict[str, Tuple[str, str, str]] = {
+    "time/step": ("host/min/step_time_s", "host/max/step_time_s",
+                  "host/spread/step_time_s"),
+    "data/stall_s": ("host/min/stall_s", "host/max/stall_s",
+                     "host/spread/stall_s"),
+    "data/queue_depth": ("host/min/queue_depth", "host/max/queue_depth",
+                         "host/spread/queue_depth"),
+}
+
+
+def merge_host_stats(latest: Dict[int, Dict[str, float]]
+                     ) -> Dict[str, float]:
+    """Fold each host's latest source values into the ``host/*`` metric
+    dict. Hosts missing a key simply don't contribute to it; keys no
+    host reports are omitted entirely."""
+    out: Dict[str, float] = {"host/reporting": float(len(latest))}
+    for src, (k_min, k_max, k_spread) in AGG_KEYS.items():
+        values = [h[src] for h in latest.values() if src in h]
+        if not values:
+            continue
+        lo, hi = min(values), max(values)
+        out[k_min] = float(lo)
+        out[k_max] = float(hi)
+        out[k_spread] = float(hi - lo)
+    return out
+
+
+class StragglerWindow:
+    """Rolling per-host step-time window → straggler ratio.
+
+    ``ratio() = max(per-host mean) / median(per-host mean)`` over the
+    last ``window`` samples per host. The median (not the min) is the
+    denominator so one *fast* outlier can't manufacture a straggler;
+    needs ≥ 2 hosts with data to be defined (returns 0.0 otherwise —
+    a single-host run can never trigger)."""
+
+    def __init__(self, window: int = 8) -> None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self._times: Dict[int, deque] = {}
+
+    def add(self, host: int, step_time_s: float) -> None:
+        if step_time_s <= 0:
+            return
+        q = self._times.get(host)
+        if q is None:
+            q = self._times[host] = deque(maxlen=self.window)
+        q.append(float(step_time_s))
+
+    def per_host_mean(self) -> Dict[int, float]:
+        return {h: sum(q) / len(q) for h, q in self._times.items() if q}
+
+    def ratio(self) -> float:
+        means = self.per_host_mean()
+        if len(means) < 2:
+            return 0.0
+        med = statistics.median(means.values())
+        if med <= 0:
+            return 0.0
+        return max(means.values()) / med
+
+
+class HostShardAggregator:
+    """Tail per-host metric shards and attach ``host/*`` aggregates.
+
+    Designed as a writer observer on host 0: ``observe_record(record)``
+    runs on the drain thread once per logged record, mutating the
+    record in place (the observer contract — sinks and the anomaly
+    engine, registered AFTER this observer, see the attached keys).
+    Each pass is incremental: per-shard byte offsets persist across
+    calls, so steady-state cost is "read the few lines that appeared
+    since the last log tick". Never raises — a torn mid-write line is
+    re-read on the next pass, any other failure is counted and logged.
+    """
+
+    def __init__(self, log_dir: str, processes: int = 0,
+                 window: int = 8) -> None:
+        self.log_dir = log_dir
+        self.processes = int(processes)
+        self.straggler = StragglerWindow(window=window)
+        self.latest: Dict[int, Dict[str, float]] = {}
+        self.errors = 0
+        self._offsets: Dict[str, int] = {}
+        self._partial: Dict[str, str] = {}
+
+    # ----------------------------------------------------------- tailing
+    def _shard_paths(self) -> List[Tuple[int, str]]:
+        try:
+            names = os.listdir(self.log_dir)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            m = SHARD_PATTERN.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.log_dir, name)))
+        return sorted(out)
+
+    def _tail_shard(self, host: int, path: str) -> None:
+        offset = self._offsets.get(path, 0)
+        try:
+            size = os.path.getsize(path)
+            if size <= offset:
+                return
+            with open(path, "r") as f:
+                f.seek(offset)
+                chunk = f.read()
+                self._offsets[path] = f.tell()
+        except OSError:
+            self.errors += 1
+            return
+        # A line torn by a concurrent append stays buffered until its
+        # newline arrives on a later pass.
+        chunk = self._partial.pop(path, "") + chunk
+        if not chunk.endswith("\n"):
+            chunk, _, rest = chunk.rpartition("\n")
+            self._partial[path] = rest
+            if not chunk:
+                return
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                self.errors += 1
+                continue
+            if not isinstance(record, dict):
+                continue
+            self.latest.setdefault(host, {}).update(
+                {k: float(v) for k, v in record.items()
+                 if isinstance(v, (int, float))})
+            ts = record.get("time/step")
+            if isinstance(ts, (int, float)):
+                self.straggler.add(host, float(ts))
+
+    def poll(self) -> Dict[str, float]:
+        """One aggregation pass: tail every shard, return the merged
+        ``host/*`` dict (empty when no shard has data yet)."""
+        for host, path in self._shard_paths():
+            self._tail_shard(host, path)
+        if not self.latest:
+            return {}
+        merged = merge_host_stats(self.latest)
+        ratio = self.straggler.ratio()
+        if ratio > 0:
+            merged["host/straggler_ratio"] = ratio
+        return merged
+
+    # ---------------------------------------------------- observer hook
+    def observe_record(self, record: Dict[str, float]) -> None:
+        """Writer-observer entry point (drain thread). Mutates the
+        record; never raises into the writer."""
+        try:
+            record.update(self.poll())
+        except Exception as exc:  # pragma: no cover - defensive
+            self.errors += 1
+            _log.warning("host-shard aggregation failed: %s", exc)
+
+
+# ------------------------------------------------ in-graph fallback path
+def allgather_host_stats(values: Dict[str, float]
+                         ) -> Optional[Dict[int, Dict[str, float]]]:
+    """Gather each process's ``values`` dict to every process via a
+    small dedicated jitted program (``process_allgather``) — the
+    fallback for deployments without a shared log filesystem. Returns
+    ``{process_index: values}`` (every host sees all hosts), or None
+    when the gather is unavailable (e.g. CPU multi-process backends
+    that cannot execute cross-process collectives).
+
+    This is a *separate* program on the log cadence: the fused train
+    step is never retraced or modified, so Layer-2/3 digests are
+    identical whether this path is enabled or not. All processes must
+    call it at the same step — the trainer's log gate is deterministic
+    in the step counter, which guarantees that.
+    """
+    import numpy as np
+
+    import jax
+
+    keys = sorted(values)
+    local = np.asarray([[float(values[k]) for k in keys]], np.float32)
+    try:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(local, tiled=True))
+    except Exception as exc:
+        _log.warning("crosshost allgather unavailable: %s", exc)
+        return None
+    if gathered.shape[0] != jax.process_count():
+        _log.warning("crosshost allgather returned %d rows for %d "
+                     "processes", gathered.shape[0], jax.process_count())
+        return None
+    return {p: {k: float(gathered[p, i]) for i, k in enumerate(keys)}
+            for p in range(gathered.shape[0])}
+
+
+class CrossHostGatherAggregator:
+    """Trainer-thread aggregation for ``crosshost_telemetry="allgather"``.
+
+    ``update(record)`` is called at the log gate on EVERY process (the
+    collective needs all participants); only the returned merged dict is
+    non-empty on host 0, which folds it into the record before enqueue.
+    Keeps the same :class:`StragglerWindow` semantics as the file path.
+    """
+
+    _SOURCES = ("time/step", "data/stall_s", "data/queue_depth")
+
+    def __init__(self, window: int = 8) -> None:
+        self.straggler = StragglerWindow(window=window)
+        self.unavailable = False
+
+    def update(self, record: Dict[str, float]) -> Dict[str, float]:
+        if self.unavailable:
+            return {}
+        import jax
+
+        local = {k: float(record[k]) for k in self._SOURCES
+                 if k in record and isinstance(record[k], (int, float))}
+        local.setdefault("time/step", 0.0)
+        per_host = allgather_host_stats(local)
+        if per_host is None:
+            self.unavailable = True  # don't retry a dead collective
+            return {}
+        if jax.process_index() != 0:
+            return {}
+        for host, vals in per_host.items():
+            ts = vals.get("time/step", 0.0)
+            if ts > 0:
+                self.straggler.add(host, ts)
+        merged = merge_host_stats(per_host)
+        ratio = self.straggler.ratio()
+        if ratio > 0:
+            merged["host/straggler_ratio"] = ratio
+        return merged

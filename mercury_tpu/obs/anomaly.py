@@ -21,6 +21,11 @@ trigger fires:
   the input path any more.
 - **mfu_floor** — measured MFU fell below the SLO floor (evaluated only
   when the device peak is known, i.e. never on CPU hosts).
+- **straggler** — the cross-host aggregator's ``host/straggler_ratio``
+  (max/median per-host step time over a rolling window,
+  ``obs/aggregate.py``) exceeded its factor: one host is pacing the
+  whole pod. Needs cross-host telemetry, so it can only fire in
+  multi-process runs (or tests that synthesize shards).
 
 On trigger the engine dumps the flight record (ring, spans, config,
 manifest, pipeline/pending-selection summary, device memory stats) and —
@@ -104,6 +109,7 @@ class AnomalyEngine:
         ess_floor: float = 0.0,
         stall_frac_max: float = 0.0,
         mfu_floor: float = 0.0,
+        straggler_factor: float = 0.0,
         cooldown_steps: int = 200,
         max_dumps: int = 8,
         dump_dir: Optional[str] = None,
@@ -118,6 +124,7 @@ class AnomalyEngine:
         self.ess_floor = float(ess_floor)
         self.stall_frac_max = float(stall_frac_max)
         self.mfu_floor = float(mfu_floor)
+        self.straggler_factor = float(straggler_factor)
         self.cooldown_steps = int(cooldown_steps)
         self.max_dumps = int(max_dumps)
         self.dump_dir = dump_dir
@@ -218,6 +225,20 @@ class AnomalyEngine:
         if self.mfu_floor > 0 and mfu and mfu < self.mfu_floor:
             self._trigger("mfu_floor", step,
                           {"mfu": mfu, "floor": self.mfu_floor})
+
+        # Attached upstream by the cross-host aggregator observer (it
+        # must be registered BEFORE this engine in the writer's
+        # observer list — the trainer guarantees that order).
+        ratio = record.get("host/straggler_ratio")
+        if (self.straggler_factor > 0 and ratio is not None
+                and ratio > self.straggler_factor):
+            detail: Dict[str, Any] = {"ratio": ratio,
+                                      "factor": self.straggler_factor}
+            for key in ("host/min/step_time_s", "host/max/step_time_s",
+                        "host/spread/step_time_s", "host/reporting"):
+                if key in record:
+                    detail[key] = record[key]
+            self._trigger("straggler", step, detail)
 
         if self.triggers:
             record["anomaly/triggers"] = float(self.triggers)

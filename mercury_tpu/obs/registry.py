@@ -53,6 +53,30 @@ METRIC_KEYS: Dict[str, str] = {
     "obs/dropped": "cumulative records dropped by the bounded queue",
     # anomaly/* — flight-recorder health accounting
     "anomaly/triggers": "cumulative anomaly triggers fired this run",
+    # host/* — cross-host aggregates merged onto host 0's records
+    # (obs/aggregate.py; multi-process runs only)
+    "host/reporting": "hosts whose telemetry shard has data this pass",
+    "host/min/step_time_s": "fastest host's latest seconds per step",
+    "host/max/step_time_s": "slowest host's latest seconds per step",
+    "host/spread/step_time_s": "max-min cross-host seconds per step",
+    "host/min/stall_s": "smallest per-host input stall this interval",
+    "host/max/stall_s": "largest per-host input stall this interval",
+    "host/spread/stall_s": "max-min cross-host input stall",
+    "host/min/queue_depth": "shallowest per-host prefetch queue",
+    "host/max/queue_depth": "deepest per-host prefetch queue",
+    "host/spread/queue_depth": "max-min cross-host prefetch queue depth",
+    "host/straggler_ratio": "max/median per-host step time (rolling)",
+    # prof/* — offline device-time attribution folded back after an
+    # anomaly-armed profiler capture (obs/profile_parse.py)
+    "prof/scope_frac/mercury_scoring": "device-time share: scoring scope",
+    "prof/scope_frac/mercury_grad_sync": "device-time share: grad sync",
+    "prof/scope_frac/mercury_augmentation":
+        "device-time share: augmentation scope",
+    "prof/scope_frac/mercury_optimizer": "device-time share: optimizer",
+    "prof/scope_frac/unattributed":
+        "device-time share outside every named scope",
+    "prof/h2d_overlap_frac": "H2D copy time hidden under device compute",
+    "prof/idle_frac": "device-lane idle gaps over the capture span",
 }
 
 #: Bookkeeping fields that ride along in every record but are not metric

@@ -1,0 +1,424 @@
+"""Offline run report + regression diff CLI — stdlib-only, jax-free.
+
+A training run with observability on leaves a directory of artifacts:
+``run_manifest.json``, the canonical ``metrics.jsonl`` plus per-host
+``metrics.h{p}.jsonl`` shards, ``heartbeat.h{p}.jsonl`` liveness shards,
+``trace.json`` (span timeline), ``flight_record_*.json`` (anomaly
+post-mortems) and ``device_time_breakdown.json`` (profiler attribution,
+``obs/profile_parse.py``). Until now a human had to read six JSON
+dialects to answer "how did this run go?". This module renders them as
+one self-contained report, and — the part CI consumes — compares two
+runs against committed per-metric tolerance rules:
+
+    python -m mercury_tpu.obs.report RUN_DIR [--out report.md] [--html]
+    python -m mercury_tpu.obs.report --diff RUN_A RUN_B
+
+``--diff`` exits non-zero naming every regressed metric, so the bench
+SLO gate and the CI smoke can consume it as a pass/fail signal. The
+tolerance rules live in ``obs/report_tolerances.json`` (override with
+``--tolerances``): per metric key, a direction (``higher_better`` /
+``lower_better``) and a relative and/or absolute tolerance; a change
+beyond tolerance in the *bad* direction is a regression, improvements
+never fail. Comparison values are the mean over each run's last
+``window`` records carrying the key — a single noisy final record
+shouldn't decide a regression.
+
+No jax, no numpy: this must run on the machine you copied the run
+directory to, not the machine that trained.
+"""
+
+from __future__ import annotations
+
+import glob
+import html as _html
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: Schema tag for the tolerance-rule file.
+TOLERANCES_SCHEMA = "mercury_report_tolerances_v1"
+
+_DEFAULT_WINDOW = 10
+
+
+def default_tolerances_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "report_tolerances.json")
+
+
+# --------------------------------------------------------------- ingest
+def _read_json(path: str) -> Optional[Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line of a live run
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        pass
+    return records
+
+
+def load_run(run_dir: str) -> Dict[str, Any]:
+    """Ingest one run directory into a plain dict. Every artifact is
+    optional — a report over a partial directory is still a report."""
+    metrics = read_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+    shards: Dict[int, List[Dict[str, Any]]] = {}
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "metrics.h*.jsonl"))):
+        name = os.path.basename(path)
+        try:
+            host = int(name[len("metrics.h"):-len(".jsonl")])
+        except ValueError:
+            continue
+        shards[host] = read_jsonl(path)
+    if not metrics and shards:
+        # No canonical stream (e.g. host 0's file was lost): fall back
+        # to host 0's shard, else the lowest-numbered one.
+        metrics = shards.get(0) or shards[min(shards)]
+    flight = []
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "flight_record_*.json"))):
+        doc = _read_json(path)
+        if isinstance(doc, dict):
+            doc["_path"] = path
+            flight.append(doc)
+    trace = _read_json(os.path.join(run_dir, "trace.json"))
+    return {
+        "dir": os.path.abspath(run_dir),
+        "manifest": _read_json(os.path.join(run_dir,
+                                            "run_manifest.json")) or {},
+        "metrics": metrics,
+        "shards": shards,
+        "flight_records": flight,
+        "breakdown": _read_json(os.path.join(
+            run_dir, "device_time_breakdown.json")),
+        "trace_events": (len(trace.get("traceEvents", []))
+                         if isinstance(trace, dict) else None),
+    }
+
+
+# -------------------------------------------------------- summarization
+def metric_series(records: Sequence[Dict[str, Any]],
+                  key: str) -> List[float]:
+    return [float(r[key]) for r in records
+            if isinstance(r.get(key), (int, float))]
+
+
+def metric_keys(records: Sequence[Dict[str, Any]]) -> List[str]:
+    keys = set()
+    for r in records:
+        keys.update(k for k, v in r.items()
+                    if "/" in k and isinstance(v, (int, float)))
+    return sorted(keys)
+
+
+def summarize_metric(records: Sequence[Dict[str, Any]], key: str,
+                     window: int = _DEFAULT_WINDOW
+                     ) -> Optional[Dict[str, float]]:
+    series = metric_series(records, key)
+    if not series:
+        return None
+    tail = series[-window:]
+    return {
+        "n": float(len(series)),
+        "last": series[-1],
+        "mean_tail": sum(tail) / len(tail),
+        "min": min(series),
+        "max": max(series),
+    }
+
+
+def comparison_value(records: Sequence[Dict[str, Any]], key: str,
+                     window: int = _DEFAULT_WINDOW) -> Optional[float]:
+    """The value the diff judges: mean over the last ``window`` records
+    carrying the key."""
+    s = summarize_metric(records, key, window=window)
+    return None if s is None else s["mean_tail"]
+
+
+# ------------------------------------------------------------ rendering
+# Reports are built as a neutral block list so markdown and HTML render
+# from the same structure: ("h", level, text) | ("p", text) |
+# ("kv", [(k, v)...]) | ("table", headers, rows).
+Block = Tuple
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _run_blocks(run: Dict[str, Any]) -> List[Block]:
+    blocks: List[Block] = [("h", 1, f"Run report: {run['dir']}")]
+    man = run["manifest"]
+    if man:
+        cfg = man.get("config", {})
+        kv = [("model", cfg.get("model")), ("dataset", cfg.get("dataset")),
+              ("world_size", cfg.get("world_size")),
+              ("sampler", cfg.get("sampler")),
+              ("device_kind", man.get("device_kind")),
+              ("processes", man.get("process_count")),
+              ("jax", man.get("jax_version")),
+              ("git", man.get("git_revision")),
+              ("started", man.get("timestamp"))]
+        blocks.append(("h", 2, "Manifest"))
+        blocks.append(("kv", [(k, v) for k, v in kv if v is not None]))
+    records = run["metrics"]
+    if records:
+        steps = metric_series(records, "step")
+        blocks.append(("h", 2, "Metrics"))
+        blocks.append(("p", f"{len(records)} records"
+                       + (f", steps {int(steps[0])}–{int(steps[-1])}"
+                          if steps else "")))
+        rows = []
+        for key in metric_keys(records):
+            s = summarize_metric(records, key)
+            rows.append([key, _fmt(s["last"]), _fmt(s["mean_tail"]),
+                         _fmt(s["min"]), _fmt(s["max"]), int(s["n"])])
+        blocks.append(("table",
+                       ["metric", "last", f"mean(last {_DEFAULT_WINDOW})",
+                        "min", "max", "n"], rows))
+    if run["shards"]:
+        blocks.append(("h", 2, "Per-host shards"))
+        rows = []
+        for host in sorted(run["shards"]):
+            recs = run["shards"][host]
+            last_step = (int(recs[-1].get("step", -1)) if recs else None)
+            st = summarize_metric(recs, "time/step")
+            stall = summarize_metric(recs, "data/stall_s")
+            rows.append([f"h{host}", len(recs), last_step,
+                         _fmt(st["mean_tail"]) if st else "—",
+                         _fmt(stall["mean_tail"]) if stall else "—"])
+        blocks.append(("table",
+                       ["host", "records", "last step",
+                        "step_time_s (tail mean)", "stall_s (tail mean)"],
+                       rows))
+    bd = run["breakdown"]
+    if isinstance(bd, dict) and bd.get("scopes"):
+        blocks.append(("h", 2, "Device-time breakdown"))
+        total = bd.get("total_device_time_us", 0.0)
+        blocks.append(("p", f"{total / 1e3:.3f} ms of device-lane time "
+                       f"({bd.get('counts', {}).get('device_events', '?')} "
+                       f"events); source: {bd.get('source', '?')}"))
+        rows = [[name, f"{s['frac']:.2%}", _fmt(s["time_us"] / 1e3)]
+                for name, s in sorted(bd["scopes"].items(),
+                                      key=lambda kv: -kv[1]["time_us"])]
+        blocks.append(("table", ["scope", "fraction", "ms"], rows))
+        blocks.append(("kv", [
+            ("h2d overlap", f"{bd['h2d']['overlap_frac']:.2%}"),
+            ("idle fraction", f"{bd['idle']['idle_frac']:.2%}")]))
+    if run["flight_records"]:
+        blocks.append(("h", 2, "Flight records"))
+        rows = [[os.path.basename(fr.get("_path", "?")),
+                 fr.get("trigger", {}).get("kind", "?"),
+                 fr.get("trigger", {}).get("step", "?"),
+                 fr.get("timestamp", "?")]
+                for fr in run["flight_records"]]
+        blocks.append(("table", ["file", "trigger", "step", "when"], rows))
+    if run["trace_events"]:
+        blocks.append(("p", f"Span trace: {run['trace_events']} events "
+                       "(trace.json — load in ui.perfetto.dev)"))
+    return blocks
+
+
+def render_markdown(blocks: List[Block]) -> str:
+    out: List[str] = []
+    for block in blocks:
+        kind = block[0]
+        if kind == "h":
+            out.append("#" * block[1] + " " + block[2])
+        elif kind == "p":
+            out.append(block[1])
+        elif kind == "kv":
+            out.extend(f"- **{k}**: {_fmt(v)}" for k, v in block[1])
+        elif kind == "table":
+            headers, rows = block[1], block[2]
+            out.append("| " + " | ".join(headers) + " |")
+            out.append("|" + "---|" * len(headers))
+            out.extend("| " + " | ".join(_fmt(c) for c in row) + " |"
+                       for row in rows)
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def render_html(blocks: List[Block]) -> str:
+    e = _html.escape
+    body: List[str] = []
+    for block in blocks:
+        kind = block[0]
+        if kind == "h":
+            body.append(f"<h{block[1]}>{e(block[2])}</h{block[1]}>")
+        elif kind == "p":
+            body.append(f"<p>{e(block[1])}</p>")
+        elif kind == "kv":
+            items = "".join(f"<li><b>{e(str(k))}</b>: {e(_fmt(v))}</li>"
+                            for k, v in block[1])
+            body.append(f"<ul>{items}</ul>")
+        elif kind == "table":
+            headers = "".join(f"<th>{e(h)}</th>" for h in block[1])
+            rows = "".join(
+                "<tr>" + "".join(f"<td>{e(_fmt(c))}</td>" for c in row)
+                + "</tr>" for row in block[2])
+            body.append(f"<table><tr>{headers}</tr>{rows}</table>")
+    style = ("body{font:14px/1.5 system-ui,sans-serif;margin:2em;"
+             "max-width:72em}table{border-collapse:collapse}"
+             "td,th{border:1px solid #ccc;padding:2px 8px;"
+             "text-align:left}")
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            f"<style>{style}</style></head><body>"
+            + "".join(body) + "</body></html>\n")
+
+
+# ----------------------------------------------------------------- diff
+def load_tolerances(path: Optional[str] = None) -> Dict[str, Any]:
+    path = path or default_tolerances_path()
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != TOLERANCES_SCHEMA:
+        raise ValueError(
+            f"{path}: expected schema {TOLERANCES_SCHEMA!r}, "
+            f"got {doc.get('schema')!r}")
+    return doc
+
+
+def diff_runs(run_a: Dict[str, Any], run_b: Dict[str, Any],
+              tolerances: Dict[str, Any]
+              ) -> Tuple[List[str], List[str]]:
+    """Judge run B (candidate) against run A (baseline). Returns
+    ``(regressions, notes)`` — formatted lines; any regression means a
+    non-zero exit. Only metrics with a committed rule can regress."""
+    window = int(tolerances.get("window", _DEFAULT_WINDOW))
+    regressions: List[str] = []
+    notes: List[str] = []
+    for key, rule in sorted(tolerances.get("rules", {}).items()):
+        a = comparison_value(run_a["metrics"], key, window=window)
+        b = comparison_value(run_b["metrics"], key, window=window)
+        if a is None or b is None:
+            which = ("both" if a is None and b is None
+                     else "baseline" if a is None else "candidate")
+            notes.append(f"skip {key}: absent in {which}")
+            continue
+        higher_better = rule.get("direction",
+                                 "higher_better") == "higher_better"
+        delta = b - a  # >0 == candidate larger
+        bad = -delta if higher_better else delta
+        rel_tol = rule.get("rel_tol")
+        abs_tol = rule.get("abs_tol")
+        allowed = max(
+            abs(a) * rel_tol if rel_tol is not None else 0.0,
+            abs_tol if abs_tol is not None else 0.0,
+        )
+        if bad > allowed:
+            rel = bad / abs(a) if a else float("inf")
+            regressions.append(
+                f"REGRESSION {key}: {a:.6g} -> {b:.6g} "
+                f"({'-' if higher_better else '+'}{rel:.1%} "
+                f"{'worse' if higher_better else 'higher'}, "
+                f"tolerance {allowed:.6g})")
+        else:
+            notes.append(f"ok {key}: {a:.6g} -> {b:.6g}")
+    return regressions, notes
+
+
+def _diff_blocks(run_a: Dict[str, Any], run_b: Dict[str, Any],
+                 regressions: List[str], notes: List[str]) -> List[Block]:
+    blocks: List[Block] = [
+        ("h", 1, "Run diff"),
+        ("kv", [("baseline", run_a["dir"]), ("candidate", run_b["dir"]),
+                ("verdict", "REGRESSED" if regressions else "OK")]),
+    ]
+    if regressions:
+        blocks.append(("h", 2, "Regressions"))
+        blocks.extend(("p", line) for line in regressions)
+    blocks.append(("h", 2, "Checked metrics"))
+    blocks.extend(("p", line) for line in notes)
+    return blocks
+
+
+# ------------------------------------------------------------------- CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m mercury_tpu.obs.report",
+        description="Render a run report, or diff two runs against "
+                    "committed tolerance rules (offline, jax-free).")
+    p.add_argument("runs", nargs="+", metavar="RUN_DIR",
+                   help="one run directory (report) or, with --diff, "
+                        "BASELINE CANDIDATE")
+    p.add_argument("--diff", action="store_true",
+                   help="compare two runs; exit 1 on regression")
+    p.add_argument("--tolerances", default=None,
+                   help="tolerance-rule JSON (default: committed "
+                        "obs/report_tolerances.json)")
+    p.add_argument("--out", default=None,
+                   help="write the report here (default: stdout)")
+    p.add_argument("--html", action="store_true",
+                   help="render HTML instead of markdown")
+    args = p.parse_args(argv)
+
+    if args.diff:
+        if len(args.runs) != 2:
+            p.error("--diff needs exactly two run directories")
+        for d in args.runs:
+            if not os.path.isdir(d):
+                print(f"error: {d} is not a directory", file=sys.stderr)
+                return 2
+        try:
+            tolerances = load_tolerances(args.tolerances)
+        except (OSError, ValueError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        run_a, run_b = load_run(args.runs[0]), load_run(args.runs[1])
+        regressions, notes = diff_runs(run_a, run_b, tolerances)
+        blocks = _diff_blocks(run_a, run_b, regressions, notes)
+        rc = 1 if regressions else 0
+    else:
+        regressions = []
+        if len(args.runs) != 1:
+            p.error("report mode takes exactly one run directory "
+                    "(use --diff to compare two)")
+        if not os.path.isdir(args.runs[0]):
+            print(f"error: {args.runs[0]} is not a directory",
+                  file=sys.stderr)
+            return 2
+        blocks = _run_blocks(load_run(args.runs[0]))
+        rc = 0
+
+    text = render_html(blocks) if args.html else render_markdown(blocks)
+    if args.out:
+        d = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(d, exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text, end="")
+    for line in regressions:  # regressions always reach stderr, even
+        print(line, file=sys.stderr)  # when the report went to a file
+    if regressions:
+        print(f"{len(regressions)} regression(s) — failing",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -297,6 +297,42 @@ def try_tensorboard_sink(log_dir: str) -> Optional[TensorBoardSink]:
     return TensorBoardSink(tb) if tb is not None else None
 
 
+class HeartbeatShardSink:
+    """Per-host liveness shard: ``heartbeat.h{p}.jsonl``, one compact
+    line per logged record, flushed on EVERY write. Unlike the buffered
+    metric shard this trades write batching for post-mortem value — a
+    wedged host's heartbeat shard is current up to its very last logged
+    record, so "when did host 3 stop?" has an answer even after a
+    SIGKILL. Rows carry only the liveness subset of keys, so the cost
+    stays one short line per log tick (on the drain thread)."""
+
+    _KEYS = ("time/step", "data/stall_s", "data/queue_depth",
+             "obs/dropped", "anomaly/triggers", "host/straggler_ratio")
+
+    def __init__(self, log_dir: str, process_index: int) -> None:
+        os.makedirs(log_dir, exist_ok=True)
+        self.process_index = int(process_index)
+        name = f"heartbeat.h{self.process_index}.jsonl"
+        self._f = open(os.path.join(log_dir, name), "a")
+
+    def write(self, record: Dict[str, float]) -> None:
+        if self._f is None:
+            return
+        row = {"step": int(record.get("step", -1)),
+               "time": float(record.get("time", 0.0)),
+               "host": self.process_index}
+        for key in self._KEYS:
+            if key in record:
+                row[key] = record[key]
+        self._f.write(json.dumps(row) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
 class HeartbeatSink:
     """Rate-limited stdout one-liner — the replacement for the trainer's
     synchronous per-log print. Emits at most once per ``every_steps``

@@ -396,12 +396,18 @@ def make_train_step(
         return logits, new_stats, aux
 
     def _augment(key, images):
+        # mercury_augmentation anchors the augmentation ops' op_name
+        # metadata for offline device-time attribution
+        # (obs/profile_parse.py). Named scopes live in source_info only —
+        # the pretty-printed jaxpr (and so Layer-2 digests) is unchanged.
         if config.augmentation == "noniid":
-            return augment_batch(key, images, use_cutout=config.cutout)
+            with jax.named_scope("mercury_augmentation"):
+                return augment_batch(key, images, use_cutout=config.cutout)
         if config.augmentation == "iid":
             from mercury_tpu.data.transforms import augment_batch_iid
 
-            return augment_batch_iid(key, images)
+            with jax.named_scope("mercury_augmentation"):
+                return augment_batch_iid(key, images)
         if config.augmentation != "none":
             raise ValueError(f"unknown augmentation {config.augmentation!r}")
         return images
@@ -557,7 +563,11 @@ def make_train_step(
                 ))
             pvec, _ = tree_flatten_to_vector(state.params)
             pchunk = pad_to_chunks(pvec, w)[lax.axis_index(axis)]
-            updates_chunk, new_opt_chunk = tx.update(gchunk, opt_chunk, pchunk)
+            # mercury_optimizer: profiler-attribution anchor for the
+            # optimizer update (obs/profile_parse.py); digest-invisible.
+            with jax.named_scope("mercury_optimizer"):
+                updates_chunk, new_opt_chunk = tx.update(
+                    gchunk, opt_chunk, pchunk)
             if int8_allreduce:
                 with jax.named_scope("mercury_grad_sync"):
                     uvec = compressed_all_gather(updates_chunk, axis, kz2)[
@@ -568,7 +578,9 @@ def make_train_step(
                     uvec = lax.all_gather(
                         updates_chunk, axis, tiled=True
                     )[: gvec.size]
-            new_params = optax.apply_updates(state.params, unravel(uvec))
+            with jax.named_scope("mercury_optimizer"):
+                new_params = optax.apply_updates(state.params,
+                                                 unravel(uvec))
             new_opt_state = jax.tree_util.tree_map(
                 lambda x: x[None], new_opt_chunk
             )
@@ -609,10 +621,11 @@ def make_train_step(
                 # Post-allreduce: already the worker-mean gradient, so the
                 # norm is identical on every worker (replicated output).
                 grad_norm = global_grad_norm(grads)
-            updates, new_opt_state = tx.update(
-                grads, state.opt_state, state.params
-            )
-            new_params = optax.apply_updates(state.params, updates)
+            with jax.named_scope("mercury_optimizer"):
+                updates, new_opt_state = tx.update(
+                    grads, state.opt_state, state.params
+                )
+                new_params = optax.apply_updates(state.params, updates)
 
         # Keep replicated BN stats replicated: under synced BN they already
         # agree; under local BN we average the running stats across workers

@@ -45,11 +45,17 @@ from mercury_tpu.data.partition import partition_data
 from mercury_tpu.data.pipeline import ShardedDataset, eval_batches, make_sharded_dataset
 from mercury_tpu.models import create_model
 from mercury_tpu.obs.accounting import ThroughputMeter, analytic_flops_per_step
+from mercury_tpu.obs.aggregate import (
+    CrossHostGatherAggregator,
+    HostShardAggregator,
+    shard_filename,
+)
 from mercury_tpu.obs.anomaly import AnomalyEngine
 from mercury_tpu.obs.manifest import build_run_manifest, write_run_manifest
 from mercury_tpu.obs.trace import NULL_TRACER, SpanTracer
 from mercury_tpu.obs.writer import (
     AsyncMetricWriter,
+    HeartbeatShardSink,
     HeartbeatSink,
     JsonlSink,
     try_tensorboard_sink,
@@ -462,12 +468,52 @@ class Trainer:
         # float()+flush() with an enqueue — device_get and filesystem IO
         # happen on a background thread (obs/writer.py).
         sinks = []
-        if config.log_dir and jax.process_index() == 0:
+        pidx = jax.process_index()
+        if config.log_dir and pidx == 0:
             write_run_manifest(config.log_dir, config, self.mesh)
             sinks.append(JsonlSink(config.log_dir))
             sinks.append(try_tensorboard_sink(config.log_dir))
-        if config.heartbeat_every and jax.process_index() == 0:
+        if config.log_dir:
+            # EVERY process (host 0 included) writes its own metric +
+            # heartbeat shards — non-zero hosts used to be completely
+            # dark, so a wedged host 3 left no post-mortem at all. The
+            # shards also feed the cross-host aggregator below.
+            sinks.append(JsonlSink(config.log_dir,
+                                   filename=shard_filename(pidx)))
+            sinks.append(HeartbeatShardSink(config.log_dir, pidx))
+        if config.heartbeat_every and pidx == 0:
             sinks.append(HeartbeatSink(every_steps=config.heartbeat_every))
+        # --- cross-host aggregation (obs/aggregate.py): host/{min,max,
+        # spread}/* + host/straggler_ratio merged onto host 0's records.
+        # "files" tails the per-host shards on the writer's drain thread
+        # (observer); "allgather" runs a small dedicated jitted gather at
+        # the log gate instead. Neither touches the fused step program.
+        mode = config.crosshost_telemetry
+        if mode not in ("auto", "off", "files", "allgather"):
+            raise ValueError(
+                f"crosshost_telemetry={mode!r}: expected one of "
+                "'auto', 'off', 'files', 'allgather'")
+        if mode == "auto":
+            mode = "files" if jax.process_count() > 1 else "off"
+        if mode == "files" and not config.log_dir:
+            mode = "off"  # file aggregation needs shards to tail
+        self._crosshost_mode = mode
+        self._host_agg: Optional[HostShardAggregator] = None
+        self._crosshost_gather: Optional[CrossHostGatherAggregator] = None
+        if pidx == 0:
+            if mode == "files":
+                self._host_agg = HostShardAggregator(
+                    config.log_dir,
+                    processes=jax.process_count(),
+                    window=config.crosshost_window,
+                )
+            elif mode == "allgather":
+                self._crosshost_gather = CrossHostGatherAggregator(
+                    window=config.crosshost_window)
+        elif mode == "allgather":
+            # Non-zero hosts still participate in the collective.
+            self._crosshost_gather = CrossHostGatherAggregator(
+                window=config.crosshost_window)
         # --- step-timeline tracer + flight recorder (obs layer 2) ---
         # Disabled tracing is the shared no-op NULL_TRACER: every span
         # call site below stays unconditional and costs ~100 ns
@@ -478,7 +524,7 @@ class Trainer:
         self.tracer = (SpanTracer(config.trace_capacity)
                        if config.trace else NULL_TRACER)
         self.anomaly: Optional[AnomalyEngine] = None
-        if config.anomaly_detection and jax.process_index() == 0:
+        if config.anomaly_detection and pidx == 0:
             self.anomaly = AnomalyEngine(
                 ring_steps=config.anomaly_window,
                 slow_step_factor=config.anomaly_slow_step_factor,
@@ -487,17 +533,21 @@ class Trainer:
                                 if config.data_placement == "host_stream"
                                 else 0.0),
                 mfu_floor=config.slo_mfu_floor,
+                straggler_factor=config.anomaly_straggler_factor,
                 cooldown_steps=config.anomaly_cooldown_steps,
                 dump_dir=config.anomaly_dir or config.log_dir,
                 tracer=self.tracer,
                 context_fn=self._flight_context,
                 profile_steps=config.anomaly_profile_steps,
             )
-        self.logger = AsyncMetricWriter(
-            sinks,
-            observers=((self.anomaly.observe_record,)
-                       if self.anomaly is not None else ()),
-        )
+        # Observer order matters: the shard aggregator attaches host/*
+        # keys first, then the anomaly engine reads them (straggler).
+        observers = []
+        if self._host_agg is not None:
+            observers.append(self._host_agg.observe_record)
+        if self.anomaly is not None:
+            observers.append(self.anomaly.observe_record)
+        self.logger = AsyncMetricWriter(sinks, observers=observers)
         # On-demand jax.profiler capture window: >0 means "this many more
         # steps, then stop_trace" (armed by an anomaly trigger).
         self._profile_steps_left = 0
@@ -786,6 +836,12 @@ class Trainer:
                             # merge here.
                             record.update(self._stream_pipe.stats())
                         record["epoch"] = (step - 1) // self.steps_per_epoch
+                        if self._crosshost_gather is not None:
+                            # allgather mode: EVERY process participates
+                            # in the (deterministic-cadence) collective;
+                            # only host 0 gets a non-empty merge back.
+                            record.update(
+                                self._crosshost_gather.update(record))
                         # Fault injection (tests/CI): poison the HOST
                         # record so the non_finite trigger path runs
                         # end-to-end; the traced program is untouched.
@@ -864,6 +920,40 @@ class Trainer:
         except Exception as exc:
             _log.warning("profiler stop failed: %s", exc)
         self.tracer.instant("profiler/stop", cat="trainer")
+        self._fold_back_profile()
+
+    def _fold_back_profile(self) -> None:
+        """Attribute the capture that just closed (obs/profile_parse —
+        offline parse, no jax) and fold the result into the metric
+        stream as prof/scope_frac/* + write device_time_breakdown.json
+        next to the metrics. Best-effort: a capture format we can't
+        parse must never take the run down."""
+        logdir = self.config.anomaly_dir or self.config.log_dir
+        if not logdir or jax.process_index() != 0:
+            return
+        try:
+            from mercury_tpu.obs.profile_parse import (
+                parse_profile,
+                scope_frac_metrics,
+                write_breakdown,
+            )
+
+            breakdown = parse_profile(os.path.join(logdir, "profile"))
+            out_dir = self.config.log_dir or logdir
+            write_breakdown(
+                breakdown,
+                os.path.join(out_dir, "device_time_breakdown.json"))
+            if breakdown["total_device_time_us"] > 0:
+                step = getattr(self._throughput, "_last_step", None) or 0
+                self.logger.write(step, scope_frac_metrics(breakdown))
+            _log.warning(
+                "device-time breakdown written: %.1f%% attributed to "
+                "named scopes",
+                100.0 * (1.0 - breakdown["scopes"]
+                         .get("unattributed", {}).get("frac", 0.0)))
+        except Exception as exc:
+            _log.warning("profile fold-back failed: %s: %s",
+                         type(exc).__name__, exc)
 
     def close(self) -> None:
         """Drain and close the metric writer and the prefetch pipeline,

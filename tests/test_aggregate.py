@@ -1,0 +1,312 @@
+"""Cross-host telemetry aggregation: merge math, the straggler window,
+incremental shard tailing (including torn lines and late-appearing
+shards), and the end-to-end observer chain — shards written by real
+concurrent subprocesses, tailed by host 0's aggregator on the writer
+drain thread, feeding the anomaly engine's ``straggler`` trigger into a
+flight record that carries the per-host spreads.
+
+Everything here is host code (stdlib + the obs package); no jax backend
+is touched, so the multi-host topology is simulated by writing the
+shards the real non-zero hosts would write.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mercury_tpu.obs.aggregate import (
+    AGG_KEYS,
+    CrossHostGatherAggregator,
+    HostShardAggregator,
+    StragglerWindow,
+    heartbeat_shard_filename,
+    merge_host_stats,
+    shard_filename,
+)
+from mercury_tpu.obs.anomaly import FLIGHT_RECORD_SCHEMA, AnomalyEngine
+from mercury_tpu.obs.writer import AsyncMetricWriter, JsonlSink
+
+
+def write_shard(log_dir, host, records):
+    path = os.path.join(str(log_dir), shard_filename(host))
+    with open(path, "a") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def shard_record(step, step_time, stall=0.0, depth=2.0):
+    return {"step": float(step), "time": 1000.0 + step,
+            "time/step": step_time, "data/stall_s": stall,
+            "data/queue_depth": depth}
+
+
+class TestMergeHostStats:
+    def test_min_max_spread_per_source(self):
+        merged = merge_host_stats({
+            0: {"time/step": 0.10, "data/stall_s": 0.0},
+            1: {"time/step": 0.30, "data/stall_s": 0.5},
+        })
+        assert merged["host/reporting"] == 2.0
+        assert merged["host/min/step_time_s"] == pytest.approx(0.10)
+        assert merged["host/max/step_time_s"] == pytest.approx(0.30)
+        assert merged["host/spread/step_time_s"] == pytest.approx(0.20)
+        assert merged["host/spread/stall_s"] == pytest.approx(0.5)
+
+    def test_missing_source_omitted_not_zeroed(self):
+        merged = merge_host_stats({0: {"time/step": 0.1}})
+        assert "host/min/queue_depth" not in merged
+        assert "host/spread/stall_s" not in merged
+
+    def test_every_agg_key_is_three_deep_family(self):
+        # The registry/lint contract: each source maps to exactly
+        # (min, max, spread) keys under host/.
+        for src, keys in AGG_KEYS.items():
+            assert len(keys) == 3
+            assert all(k.startswith("host/") for k in keys)
+
+
+class TestStragglerWindow:
+    def test_single_host_never_defines_ratio(self):
+        w = StragglerWindow(window=4)
+        for _ in range(8):
+            w.add(0, 0.1)
+        assert w.ratio() == 0.0
+
+    def test_slow_host_over_median(self):
+        w = StragglerWindow(window=4)
+        for _ in range(4):
+            w.add(0, 0.1)
+            w.add(1, 0.1)
+            w.add(2, 0.3)
+        assert w.ratio() == pytest.approx(3.0)
+
+    def test_fast_outlier_cannot_manufacture_straggler(self):
+        # Median denominator: one abnormally FAST host must not make the
+        # normal hosts look 10x slow.
+        w = StragglerWindow(window=4)
+        w.add(0, 0.01)
+        w.add(1, 0.1)
+        w.add(2, 0.1)
+        assert w.ratio() == pytest.approx(1.0)
+
+    def test_rolling_window_forgets_old_samples(self):
+        w = StragglerWindow(window=2)
+        w.add(0, 1.0)  # old spike, should roll out
+        for _ in range(2):
+            w.add(0, 0.1)
+            w.add(1, 0.1)
+        assert w.ratio() == pytest.approx(1.0)
+
+    def test_nonpositive_samples_ignored(self):
+        w = StragglerWindow(window=4)
+        w.add(0, 0.0)
+        w.add(0, -1.0)
+        assert w.per_host_mean() == {}
+
+    def test_window_validated(self):
+        with pytest.raises(ValueError):
+            StragglerWindow(window=0)
+
+
+class TestHostShardAggregator:
+    def test_poll_merges_latest_per_host(self, tmp_path):
+        write_shard(tmp_path, 0, [shard_record(1, 0.10),
+                                  shard_record(2, 0.12)])
+        write_shard(tmp_path, 1, [shard_record(1, 0.50)])
+        agg = HostShardAggregator(str(tmp_path), processes=2)
+        merged = agg.poll()
+        assert merged["host/reporting"] == 2.0
+        # Latest (not first) value per host wins.
+        assert merged["host/min/step_time_s"] == pytest.approx(0.12)
+        assert merged["host/max/step_time_s"] == pytest.approx(0.50)
+
+    def test_incremental_tailing_reads_only_new_bytes(self, tmp_path):
+        path = write_shard(tmp_path, 0, [shard_record(1, 0.1)])
+        write_shard(tmp_path, 1, [shard_record(1, 0.1)])
+        agg = HostShardAggregator(str(tmp_path), processes=2)
+        agg.poll()
+        offset = agg._offsets[path]
+        assert offset == os.path.getsize(path)
+        write_shard(tmp_path, 0, [shard_record(2, 0.2)])
+        merged = agg.poll()
+        assert agg._offsets[path] > offset
+        assert merged["host/max/step_time_s"] == pytest.approx(0.2)
+
+    def test_torn_line_buffered_until_newline_arrives(self, tmp_path):
+        path = os.path.join(str(tmp_path), shard_filename(0))
+        full = json.dumps(shard_record(1, 0.25)) + "\n"
+        with open(path, "w") as f:
+            f.write(full[: len(full) // 2])  # mid-write snapshot
+        agg = HostShardAggregator(str(tmp_path), processes=1)
+        assert agg.poll() == {}  # half a line is not a record
+        assert agg.errors == 0
+        with open(path, "a") as f:
+            f.write(full[len(full) // 2:])
+        merged = agg.poll()
+        assert merged["host/max/step_time_s"] == pytest.approx(0.25)
+
+    def test_late_appearing_shard_joins(self, tmp_path):
+        write_shard(tmp_path, 0, [shard_record(1, 0.1)])
+        agg = HostShardAggregator(str(tmp_path), processes=2)
+        assert agg.poll()["host/reporting"] == 1.0
+        write_shard(tmp_path, 1, [shard_record(1, 0.4)])
+        merged = agg.poll()
+        assert merged["host/reporting"] == 2.0
+        assert merged["host/spread/step_time_s"] == pytest.approx(0.3)
+
+    def test_garbage_line_counted_not_fatal(self, tmp_path):
+        path = os.path.join(str(tmp_path), shard_filename(0))
+        with open(path, "w") as f:
+            f.write("{not json}\n")
+            f.write(json.dumps(shard_record(1, 0.1)) + "\n")
+        agg = HostShardAggregator(str(tmp_path), processes=1)
+        merged = agg.poll()
+        assert agg.errors == 1
+        assert merged["host/max/step_time_s"] == pytest.approx(0.1)
+
+    def test_empty_dir_and_missing_dir_are_empty_merges(self, tmp_path):
+        assert HostShardAggregator(str(tmp_path)).poll() == {}
+        gone = os.path.join(str(tmp_path), "nope")
+        assert HostShardAggregator(gone).poll() == {}
+
+    def test_straggler_ratio_attached_when_defined(self, tmp_path):
+        # 3 hosts: the median is the typical host, so the slow one reads
+        # as max/median = 3x.
+        for _ in range(4):
+            write_shard(tmp_path, 0, [shard_record(1, 0.1)])
+            write_shard(tmp_path, 1, [shard_record(1, 0.1)])
+            write_shard(tmp_path, 2, [shard_record(1, 0.3)])
+        agg = HostShardAggregator(str(tmp_path), processes=3)
+        merged = agg.poll()
+        assert merged["host/straggler_ratio"] == pytest.approx(3.0)
+
+    def test_observe_record_mutates_in_place_never_raises(self, tmp_path):
+        write_shard(tmp_path, 0, [shard_record(1, 0.1)])
+        write_shard(tmp_path, 1, [shard_record(1, 0.2)])
+        agg = HostShardAggregator(str(tmp_path), processes=2)
+        rec = {"step": 1.0, "time": 1001.0}
+        agg.observe_record(rec)
+        assert rec["host/reporting"] == 2.0
+
+    def test_subprocess_written_shards(self, tmp_path):
+        # The real topology in miniature: each "host" is a separate OS
+        # process appending its own shard (os.open O_APPEND line writes,
+        # like JsonlSink); host 0's aggregator reads them all back.
+        writer = (
+            "import json, sys\n"
+            "host, factor, path = int(sys.argv[1]), float(sys.argv[2]), "
+            "sys.argv[3]\n"
+            "with open(path, 'a') as f:\n"
+            "    for s in range(1, 7):\n"
+            "        rec = {'step': float(s), 'time': 1000.0 + s,\n"
+            "               'time/step': 0.1 * factor,\n"
+            "               'data/stall_s': 0.01 * host,\n"
+            "               'data/queue_depth': 2.0}\n"
+            "        f.write(json.dumps(rec) + '\\n')\n"
+            "        f.flush()\n"
+        )
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", writer, str(h), str(factor),
+                 os.path.join(str(tmp_path), shard_filename(h))])
+            for h, factor in ((0, 1.0), (1, 1.0), (2, 2.5))
+        ]
+        for p in procs:
+            assert p.wait(timeout=60) == 0
+        agg = HostShardAggregator(str(tmp_path), processes=3)
+        merged = agg.poll()
+        assert merged["host/reporting"] == 3.0
+        assert merged["host/min/step_time_s"] == pytest.approx(0.1)
+        assert merged["host/max/step_time_s"] == pytest.approx(0.25)
+        assert merged["host/straggler_ratio"] == pytest.approx(2.5)
+
+    def test_straggler_trigger_end_to_end_flight_record(self, tmp_path):
+        # The full host-0 chain on a real writer drain thread: per-host
+        # shards on disk -> HostShardAggregator observer attaches
+        # host/* -> AnomalyEngine observer (registered AFTER, the
+        # trainer's ordering) sees host/straggler_ratio and dumps a
+        # flight record whose detail carries the per-host spreads.
+        log_dir = str(tmp_path)
+        for _ in range(4):
+            write_shard(tmp_path, 0, [shard_record(1, 0.1)])
+            write_shard(tmp_path, 1, [shard_record(1, 0.1)])
+            write_shard(tmp_path, 2, [shard_record(1, 0.32)])
+        agg = HostShardAggregator(log_dir, processes=3)
+        eng = AnomalyEngine(ring_steps=8, dump_dir=log_dir,
+                            straggler_factor=2.0)
+        writer = AsyncMetricWriter(
+            [JsonlSink(log_dir)],
+            observers=[agg.observe_record, eng.observe_record])
+        writer.write(1, {"train/loss": 1.0, "time/step": 0.1})
+        writer.close()
+        assert eng.trigger_counts == {"straggler": 1}
+        (path,) = eng.dumps
+        doc = json.load(open(path))
+        assert doc["schema"] == FLIGHT_RECORD_SCHEMA
+        assert doc["trigger"]["kind"] == "straggler"
+        detail = doc["trigger"]["detail"]
+        assert detail["ratio"] == pytest.approx(3.2)
+        assert detail["host/spread/step_time_s"] == pytest.approx(0.22)
+        assert detail["host/reporting"] == 3.0
+        # The merged keys also rode into the primary stream.
+        with open(os.path.join(log_dir, "metrics.jsonl")) as f:
+            (rec,) = [json.loads(l) for l in f if l.strip()]
+        assert rec["host/straggler_ratio"] == pytest.approx(3.2)
+
+
+class TestAnomalyEngineStraggler:
+    def test_factor_zero_disables(self):
+        eng = AnomalyEngine(ring_steps=4, straggler_factor=0.0)
+        eng.observe_record({"step": 1.0, "time": 1001.0,
+                            "host/straggler_ratio": 99.0})
+        assert eng.triggers == 0
+
+    def test_ratio_over_factor_triggers_once_per_record(self):
+        eng = AnomalyEngine(ring_steps=4, straggler_factor=2.0)
+        eng.observe_record({"step": 1.0, "time": 1001.0,
+                            "host/straggler_ratio": 1.5})
+        assert eng.triggers == 0
+        eng.observe_record({"step": 2.0, "time": 1002.0,
+                            "host/straggler_ratio": 2.5})
+        assert eng.trigger_counts == {"straggler": 1}
+
+
+class TestCrossHostGatherAggregator:
+    def test_single_process_merge_is_self_view(self):
+        # On a 1-process backend process_allgather degenerates to the
+        # local row: the merge must be a valid single-host view with no
+        # straggler (ratio undefined for < 2 hosts).
+        agg = CrossHostGatherAggregator(window=4)
+        merged = agg.update({"step": 1.0, "time/step": 0.2,
+                             "data/stall_s": 0.05})
+        if agg.unavailable:
+            pytest.skip("process_allgather unavailable on this backend")
+        assert merged["host/reporting"] == 1.0
+        assert merged["host/max/step_time_s"] == pytest.approx(0.2)
+        assert "host/straggler_ratio" not in merged
+
+    def test_unavailable_latch_stops_retrying(self, monkeypatch):
+        import mercury_tpu.obs.aggregate as agg_mod
+
+        calls = {"n": 0}
+
+        def dead(values):
+            calls["n"] += 1
+            return None
+
+        monkeypatch.setattr(agg_mod, "allgather_host_stats", dead)
+        agg = CrossHostGatherAggregator()
+        assert agg.update({"time/step": 0.1}) == {}
+        assert agg.update({"time/step": 0.1}) == {}
+        assert agg.unavailable
+        assert calls["n"] == 1  # second update never touched the collective
+
+
+class TestShardFilenames:
+    def test_shapes(self):
+        assert shard_filename(3) == "metrics.h3.jsonl"
+        assert heartbeat_shard_filename(0) == "heartbeat.h0.jsonl"

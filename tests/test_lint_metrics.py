@@ -123,6 +123,46 @@ class TestLayerM:
         with pytest.raises(ValueError):
             load_registry(str(reg))
 
+    def test_two_level_host_prof_keys_are_keys(self, tmp_path):
+        # host/{min,max,spread}/* and prof/scope_frac/* are two levels
+        # deep — KEY_RE must judge them (a typo'd deep key is GLM01).
+        paths, reg, doc = write_tree(
+            tmp_path,
+            package={"a.py": 'k = "host/spread/step_time_s"\n'
+                             'p = "prof/scope_frac/mercury_scoring"\n'},
+            registry='METRIC_KEYS = {\n'
+                     '    "host/spread/step_time_s": "spread",\n'
+                     '    "prof/scope_frac/mercury_scoring": "frac",\n'
+                     '}\n',
+            docs="`host/spread/step_time_s` `prof/scope_frac/"
+                 "mercury_scoring`\n")
+        errors, warnings = run_metrics_check(paths, reg, doc)
+        assert errors == []
+        assert warnings == []
+
+    def test_glm01_unregistered_prof_key(self, tmp_path):
+        paths, reg, doc = write_tree(
+            tmp_path,
+            package={"a.py": 'k = "prof/scope_frac/mercury_typo"\n'})
+        errors, _ = run_metrics_check(paths, reg, doc)
+        assert len(errors) == 1
+        assert "GLM01" in errors[0]
+        assert "prof/scope_frac/mercury_typo" in errors[0]
+
+    def test_real_registry_is_subset_of_docs(self):
+        # Round-trip over the REAL triple: every registered key —
+        # including the host/* and prof/* families added for cross-host
+        # telemetry — has a docs-glossary entry.
+        from mercury_tpu.lint import metrics as lm
+
+        registry = load_registry(lm._default_registry_path())
+        documented = documented_keys(lm._default_docs_path())
+        assert set(registry) <= documented, \
+            sorted(set(registry) - documented)
+        for family in ("host/straggler_ratio", "host/spread/step_time_s",
+                       "prof/scope_frac/unattributed", "prof/idle_frac"):
+            assert family in registry
+
     def test_real_repo_is_clean(self):
         # The CI gate itself: the shipped package/registry/docs triple
         # must audit clean (warnings allowed — the f-string eval family).

@@ -34,6 +34,7 @@ from mercury_tpu.obs.manifest import build_run_manifest, write_run_manifest
 from mercury_tpu.obs.writer import (
     AsyncMetricWriter,
     HeartbeatSink,
+    HeartbeatShardSink,
     JsonlSink,
 )
 from mercury_tpu.sampling.scoretable import refresh_period
@@ -318,6 +319,26 @@ class TestJsonlSink:
         assert [r["step"] for r in recs] == [1, 2]
         assert recs[0]["train/loss"] == 2.5
         sink.close()  # idempotent
+
+
+class TestHeartbeatShardSink:
+    def test_one_flushed_row_per_record_with_liveness_subset(self, tmp_path):
+        sink = HeartbeatShardSink(str(tmp_path), process_index=3)
+        sink.write({"step": 5.0, "time": 1005.0, "time/step": 0.1,
+                    "train/loss": 2.0, "data/stall_s": 0.02})
+        # Flushed on write — readable BEFORE close (the post-mortem
+        # contract: a SIGKILLed host leaves its last state on disk).
+        lines = (tmp_path / "heartbeat.h3.jsonl").read_text().splitlines()
+        (row,) = [json.loads(l) for l in lines]
+        assert row["step"] == 5 and row["host"] == 3
+        assert row["time/step"] == 0.1
+        assert row["data/stall_s"] == 0.02
+        assert "train/loss" not in row  # liveness subset only
+        sink.close()
+        sink.close()  # idempotent
+        sink.write({"step": 6.0})  # write-after-close is a no-op
+        assert len((tmp_path / "heartbeat.h3.jsonl")
+                   .read_text().splitlines()) == 1
 
 
 class TestHeartbeatSink:

@@ -1,0 +1,239 @@
+"""Offline run-report CLI (obs/report.py): run-dir ingestion (including
+the host-shard fallback when the primary stream is missing), summary
+math, markdown/HTML rendering, the tolerance-gated --diff against the
+committed fixture pair (run_b carries a seeded -30% MFU regression plus
+a straggler), exit codes, and the no-jax-import contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mercury_tpu.obs.report import (
+    TOLERANCES_SCHEMA,
+    comparison_value,
+    default_tolerances_path,
+    diff_runs,
+    load_run,
+    load_tolerances,
+    main,
+    metric_keys,
+    read_jsonl,
+    render_html,
+    render_markdown,
+    summarize_metric,
+    _run_blocks,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "run_report")
+RUN_A = os.path.join(FIXTURES, "run_a")
+RUN_B = os.path.join(FIXTURES, "run_b")
+
+
+def records(n=20, key="perf/mfu", base=0.02, slope=0.0):
+    return [{"step": float(s), "time": 1000.0 + s, key: base + slope * s}
+            for s in range(1, n + 1)]
+
+
+class TestIngestion:
+    def test_load_run_fixture(self):
+        run = load_run(RUN_A)
+        assert run["manifest"]["config"]["model"] == "smallcnn"
+        assert len(run["metrics"]) == 30
+        assert set(run["shards"]) == {0, 1}
+        assert "perf/mfu" in metric_keys(run["metrics"])
+
+    def test_empty_run_dir_is_still_a_run(self, tmp_path):
+        # Every artifact is optional: a partial rsync renders a (thin)
+        # report rather than crashing.
+        run = load_run(str(tmp_path))
+        assert run["metrics"] == []
+        assert run["flight_records"] == []
+
+    def test_shard_fallback_when_primary_missing(self, tmp_path):
+        # A non-zero host's view of a crashed run: only shards exist —
+        # the report still has a metric stream.
+        with open(str(tmp_path / "metrics.h1.jsonl"), "w") as f:
+            for r in records(5):
+                f.write(json.dumps(r) + "\n")
+        run = load_run(str(tmp_path))
+        assert len(run["metrics"]) == 5
+
+    def test_read_jsonl_tolerates_torn_tail(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"step": 1.0}) + "\n")
+            f.write('{"step": 2.0, "tr')  # torn mid-write
+        assert [r["step"] for r in read_jsonl(path)] == [1.0]
+
+
+class TestSummaries:
+    def test_comparison_value_is_mean_of_last_window(self):
+        recs = records(20, base=0.0, slope=0.01)  # 0.01 .. 0.20
+        # Last 5: steps 16..20 -> mean 0.18.
+        assert comparison_value(recs, "perf/mfu",
+                                window=5) == pytest.approx(0.18)
+
+    def test_absent_key_is_none(self):
+        assert comparison_value(records(3), "train/loss", window=5) is None
+
+    def test_summarize_metric_fields(self):
+        s = summarize_metric(records(10, base=1.0, slope=1.0), "perf/mfu")
+        assert s["n"] == 10
+        assert s["min"] == pytest.approx(2.0)
+        assert s["max"] == pytest.approx(11.0)
+        assert s["last"] == pytest.approx(11.0)
+
+
+class TestRendering:
+    def test_markdown_report_sections(self):
+        md = render_markdown(_run_blocks(load_run(RUN_A)))
+        for needle in ("# Run report", "## Manifest", "## Metrics",
+                       "## Per-host shards", "perf/mfu"):
+            assert needle in md, needle
+
+    def test_html_is_self_contained(self):
+        html = render_html(_run_blocks(load_run(RUN_A)))
+        assert html.lower().startswith("<!doctype html>")
+        assert "<style>" in html  # inline CSS, no external fetches
+        assert "src=" not in html
+
+    def test_breakdown_section_present_when_file_exists(self, tmp_path):
+        with open(str(tmp_path / "metrics.jsonl"), "w") as f:
+            f.write(json.dumps(records(1)[0]) + "\n")
+        with open(str(tmp_path / "device_time_breakdown.json"), "w") as f:
+            json.dump({"schema": "mercury_device_time_breakdown_v1",
+                       "scopes": {"mercury_scoring":
+                                  {"time_us": 1.0, "frac": 1.0}},
+                       "total_device_time_us": 1.0,
+                       "attributed_frac": 1.0,
+                       "h2d": {"overlap_frac": 0.0},
+                       "idle": {"idle_frac": 0.0}}, f)
+        md = render_markdown(_run_blocks(load_run(str(tmp_path))))
+        assert "Device-time breakdown" in md
+        assert "mercury_scoring" in md
+
+
+class TestTolerances:
+    def test_committed_rules_load_and_validate(self):
+        tol = load_tolerances()
+        assert tol["schema"] == TOLERANCES_SCHEMA
+        assert "perf/mfu" in tol["rules"]
+        for key, rule in tol["rules"].items():
+            assert rule["direction"] in ("higher_better", "lower_better"), key
+            assert "rel_tol" in rule or "abs_tol" in rule, key
+
+    def test_bad_schema_rejected(self, tmp_path):
+        path = str(tmp_path / "tol.json")
+        with open(path, "w") as f:
+            json.dump({"schema": "wrong", "rules": {}}, f)
+        with pytest.raises(ValueError):
+            load_tolerances(path)
+
+    def test_default_path_is_committed_file(self):
+        assert os.path.exists(default_tolerances_path())
+
+
+class TestDiff:
+    def test_fixture_regression_named(self):
+        regs, notes = diff_runs(load_run(RUN_A), load_run(RUN_B),
+                                load_tolerances())
+        assert any("REGRESSION perf/mfu" in r for r in regs)
+        # run_a never developed a straggler, so that rule is skipped
+        # (absent in baseline), not silently passed.
+        assert any("skip host/straggler_ratio" in n for n in notes)
+
+    def test_improvement_never_fails(self):
+        tol = {"schema": TOLERANCES_SCHEMA, "window": 5,
+               "rules": {"perf/mfu": {"direction": "higher_better",
+                                      "rel_tol": 0.1}}}
+        a = {"metrics": records(10, base=0.02), "dir": "a"}
+        b = {"metrics": records(10, base=0.04), "dir": "b"}  # 2x better
+        regs, notes = diff_runs(a, b, tol)
+        assert regs == []
+        assert any(n.startswith("ok perf/mfu") for n in notes)
+
+    def test_lower_better_direction(self):
+        tol = {"schema": TOLERANCES_SCHEMA, "window": 5,
+               "rules": {"train/loss": {"direction": "lower_better",
+                                        "rel_tol": 0.1}}}
+        a = {"metrics": records(10, key="train/loss", base=1.0), "dir": "a"}
+        b = {"metrics": records(10, key="train/loss", base=1.5), "dir": "b"}
+        regs, _ = diff_runs(a, b, tol)
+        assert len(regs) == 1 and "train/loss" in regs[0]
+
+    def test_unruled_keys_never_gate(self):
+        tol = {"schema": TOLERANCES_SCHEMA, "window": 5, "rules": {}}
+        a = {"metrics": records(10, base=1.0), "dir": "a"}
+        b = {"metrics": records(10, base=0.0001), "dir": "b"}
+        assert diff_runs(a, b, tol) == ([], [])
+
+    def test_absent_key_skipped_with_note(self):
+        tol = {"schema": TOLERANCES_SCHEMA, "window": 5,
+               "rules": {"sampler/ess": {"direction": "higher_better",
+                                         "rel_tol": 0.1}}}
+        a = {"metrics": records(10), "dir": "a"}
+        b = {"metrics": records(10), "dir": "b"}
+        regs, notes = diff_runs(a, b, tol)
+        assert regs == []
+        assert any("skip sampler/ess" in n for n in notes)
+
+    def test_abs_tol_floors_noise_near_zero(self):
+        tol = {"schema": TOLERANCES_SCHEMA, "window": 5,
+               "rules": {"data/stall_s": {"direction": "lower_better",
+                                          "rel_tol": 0.1,
+                                          "abs_tol": 0.05}}}
+        a = {"metrics": records(10, key="data/stall_s", base=0.001),
+             "dir": "a"}
+        b = {"metrics": records(10, key="data/stall_s", base=0.04),
+             "dir": "b"}
+        regs, _ = diff_runs(a, b, tol)  # +0.039 < abs_tol 0.05
+        assert regs == []
+
+
+class TestCli:
+    def test_report_rc0_writes_markdown(self, tmp_path, capsys):
+        out = str(tmp_path / "report.md")
+        assert main([RUN_A, "--out", out]) == 0
+        assert "# Run report" in open(out).read()
+
+    def test_html_output(self, tmp_path):
+        out = str(tmp_path / "report.html")
+        assert main([RUN_A, "--out", out, "--html"]) == 0
+        assert open(out).read().lower().startswith("<!doctype html>")
+
+    def test_diff_regression_exits_1_naming_metric(self, tmp_path, capsys):
+        out = str(tmp_path / "diff.md")
+        rc = main(["--diff", RUN_A, RUN_B, "--out", out])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "REGRESSION perf/mfu" in captured.err
+        assert "failing" in captured.err
+
+    def test_diff_self_is_clean(self, capsys):
+        assert main(["--diff", RUN_A, RUN_A]) == 0
+
+    def test_missing_dir_is_rc2(self, tmp_path, capsys):
+        assert main([str(tmp_path / "nope")]) == 2
+        assert "error" in capsys.readouterr().err
+
+    def test_diff_never_imports_jax(self):
+        # The acceptance criterion verbatim: report --diff on a box with
+        # no jax (simulated: assert the import never happens).
+        code = (
+            "import sys\n"
+            "from mercury_tpu.obs.report import main\n"
+            f"rc = main(['--diff', {RUN_A!r}, {RUN_B!r}])\n"
+            "assert rc == 1, rc\n"
+            "assert 'jax' not in sys.modules, 'jax was imported'\n"
+        )
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stderr
+        assert "REGRESSION perf/mfu" in r.stderr

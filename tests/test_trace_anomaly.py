@@ -355,6 +355,16 @@ class TestTrainerIntegration:
         lines = [json.loads(l) for l in
                  open(os.path.join(logdir, "metrics.jsonl"))]
         assert any(r.get("anomaly/triggers", 0) >= 1 for r in lines)
+
+        # Dark-host fix: this process wrote its own telemetry shard and
+        # flushed heartbeat shard alongside the primary stream.
+        shard = [json.loads(l) for l in
+                 open(os.path.join(logdir, "metrics.h0.jsonl"))]
+        assert [r["step"] for r in shard] == [r["step"] for r in lines]
+        hb = [json.loads(l) for l in
+              open(os.path.join(logdir, "heartbeat.h0.jsonl"))]
+        assert len(hb) == len(lines)
+        assert all(r["host"] == 0 for r in hb)
         # ~every post-injection loss is the injected NaN exactly once —
         # the injection latches after one poisoned record.
         nans = [r for r in lines
