@@ -19,9 +19,16 @@ ordering holds anywhere the scoring forward dominates):
 - **step wall-clock** — uniform, pool K=1 Mercury, cadence K=8, and the
   scoretable arm, same protocol as ``is_cost_ladder.py``.
 
+``--mode async`` is the async-scorer headline: uniform vs
+``refresh_mode="async"`` only (the FLOPs probe is skipped — the async
+plan's in-graph scoring cost is exactly zero by construction, pinned by
+the graftlint ``async`` budget), with the background fleet live during
+the timed loop so the number includes any host-thread interference.
+
 Usage::
 
     python benchmarks/scoring_cost.py [--steps 30] [--refresh-size 64]
+    python benchmarks/scoring_cost.py --mode async
 
 Appends one JSON record to ``benchmarks/results_scoring_cost.jsonl``.
 """
@@ -93,23 +100,45 @@ def scoring_flops(trainer, n: int):
     return float(costs.get("flops", float("nan")))
 
 
-def measure(trainer, args) -> float:
-    """Steps/sec, host-fetch fenced (is_cost_ladder.py protocol)."""
+def _segment(label, trainer, n, counters) -> float:
+    """One fenced timed segment of ``n`` steps; returns steps/sec.
+
+    Drives ``trainer.state`` (not a local copy) so the async fleet's
+    between-step apply tick composes: under ``refresh_mode="async"`` the
+    timed loop includes draining scored chunks into the table — the
+    realistic steady-state cost, not a fleet-paused best case."""
     ds = trainer.dataset
-    state = trainer.state
     step_fn = trainer.train_step
-    for _ in range(3):
-        state, metrics = step_fn(state, ds.x_train, ds.y_train,
-                                 ds.shard_indices)
-        np.asarray(metrics["train/loss"])
+    fleet = getattr(trainer, "_scorer_fleet", None)
     t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, metrics = step_fn(state, ds.x_train, ds.y_train,
-                                 ds.shard_indices)
+    for _ in range(n):
+        trainer.state, metrics = step_fn(
+            trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+        counters[label] += 1
+        if fleet is not None:
+            trainer._async_refresh_tick(counters[label])
     np.asarray(metrics["train/loss"])
-    dt = time.perf_counter() - t0
-    trainer.state = state
-    return args.steps / dt
+    return n / (time.perf_counter() - t0)
+
+
+def measure_all(trainers, args):
+    """Best-of-``reps`` over INTERLEAVED timed segments.
+
+    One sequential pass per arm (the is_cost_ladder protocol) is fine
+    for the ladder's coarse ordering, but the async headline is a ≤2%
+    claim — slow drift between arms (CPU frequency scaling, noisy
+    container neighbors; observed 60% swings run-to-run) would dwarf it.
+    Alternating short segments exposes every arm to the same drift, and
+    best-of is the least-interference estimate of each arm's step time."""
+    counters = {label: 0 for label in trainers}
+    for label, tr in trainers.items():   # compile + warmup, untimed
+        _segment(label, tr, 3, counters)
+    best = {label: 0.0 for label in trainers}
+    for _ in range(args.reps):
+        for label, tr in trainers.items():
+            best[label] = max(best[label],
+                              _segment(label, tr, args.steps, counters))
+    return best
 
 
 def main(argv=None) -> int:
@@ -119,7 +148,19 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--presample-batches", type=int, default=10)
     ap.add_argument("--refresh-size", type=int, default=64)
-    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--steps", type=int, default=30,
+                    help="steps per timed segment")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="interleaved timed segments per arm (best-of)")
+    ap.add_argument("--mode", choices=("full", "async"), default="full",
+                    help="async: uniform vs the async scorer fleet only "
+                         "(CI smoke for the off-step refresh headline)")
+    ap.add_argument("--scorer-throttle", type=float, default=0.5,
+                    help="scorer_throttle_s for the async arm: on a "
+                         "single-core CPU smoke an unthrottled fleet "
+                         "steals the step's core — the headline measures "
+                         "the step program, so the fleet idles between "
+                         "chunks (table age-decay absorbs the staleness)")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "results_scoring_cost.jsonl"))
     args = ap.parse_args(argv)
@@ -130,40 +171,55 @@ def main(argv=None) -> int:
     print(f"# platform {dev.platform} / {dev.device_kind}", file=sys.stderr)
 
     pool_size = args.presample_batches * args.batch_size
-    # local BN: the probe's forward runs outside shard_map, where sync
-    # BN's pmean axis is unbound (W=1 makes the two identical anyway).
-    probe = build(args, use_importance_sampling=False, batch_norm="local")
-    flops_pool = scoring_flops(probe, pool_size)
-    flops_table = scoring_flops(probe, args.refresh_size)
-    del probe
-    flops_ratio = (flops_pool / flops_table
-                   if flops_pool and flops_table else None)
-    print(f"# scoring FLOPs/step: pool({pool_size})={flops_pool:.3e} "
-          f"scoretable({args.refresh_size})={flops_table:.3e} "
-          f"ratio={flops_ratio:.2f}x", file=sys.stderr)
+    flops_pool = flops_table = flops_ratio = None
+    if args.mode == "full":
+        # local BN: the probe's forward runs outside shard_map, where sync
+        # BN's pmean axis is unbound (W=1 makes the two identical anyway).
+        probe = build(args, use_importance_sampling=False,
+                      batch_norm="local")
+        flops_pool = scoring_flops(probe, pool_size)
+        flops_table = scoring_flops(probe, args.refresh_size)
+        probe.close()
+        flops_ratio = (flops_pool / flops_table
+                       if flops_pool and flops_table else None)
+        print(f"# scoring FLOPs/step: pool({pool_size})={flops_pool:.3e} "
+              f"scoretable({args.refresh_size})={flops_table:.3e} "
+              f"ratio={flops_ratio:.2f}x", file=sys.stderr)
 
-    arms = [
-        ("uniform", {"use_importance_sampling": False}),
-        ("is_pool_k1", {}),
-        ("is_k8", {"score_refresh_every": 8}),
-        ("is_scoretable", {"sampler": "scoretable"}),
-    ]
+    async_arm = ("is_scoretable_async",
+                 {"sampler": "scoretable", "refresh_mode": "async",
+                  "scorer_throttle_s": args.scorer_throttle})
+    if args.mode == "async":
+        arms = [("uniform", {"use_importance_sampling": False}), async_arm]
+    else:
+        arms = [
+            ("uniform", {"use_importance_sampling": False}),
+            ("is_pool_k1", {}),
+            ("is_k8", {"score_refresh_every": 8}),
+            ("is_scoretable", {"sampler": "scoretable"}),
+            async_arm,
+        ]
+    trainers = {}
     results = {}
     for label, overrides in arms:
         try:
-            trainer = build(args, **overrides)
-            sps = measure(trainer, args)
-            del trainer
+            trainers[label] = build(args, **overrides)
         except Exception as e:  # one arm must not kill the run
             print(f"# arm {label} failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
-            sps = None
+            results[label] = None
+    measured = measure_all(trainers, args)
+    for label, tr in trainers.items():
+        tr.close()
+    for label, sps in measured.items():
         results[label] = round(sps, 2) if sps else None
         print(f"# {label}: {results[label]} steps/s", file=sys.stderr)
 
     uniform = results.get("uniform")
     record = {
         "schema": "scoring_cost_v1",
+        "mode": args.mode,
+        "scorer_throttle_s": args.scorer_throttle,
         "model": args.model,
         "dataset": args.dataset,
         "batch_size": args.batch_size,

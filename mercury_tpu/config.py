@@ -172,6 +172,34 @@ class TrainConfig:
     # cycle at L≈2200/refresh 64. 1.0 disables the decay (scores persist
     # until re-scored, the groupwise behavior).
     table_decay: float = 0.98
+    # Scoretable sampler: where the round-robin refresh forward runs.
+    # - "sync": in-graph, inside the fused step (the default — refresh_size
+    #   scoring FLOPs per step on the critical path);
+    # - "async": on a background scorer fleet (sampling/scorer_fleet.py) —
+    #   host threads re-score round-robin chunks against a periodically-
+    #   snapshotted copy of the params and stream (slots, scores) chunks
+    #   into the device table between steps, staleness-weighted by
+    #   table_decay**age. The fused step's refresh branch compiles away:
+    #   zero scoring FLOPs/collectives in the hot program (the graftlint
+    #   `async` plan budgets enforce this), at the price of score ages
+    #   measured in steps. Requires sampler="scoretable"; single-controller
+    #   (one-process) runs only.
+    refresh_mode: str = "sync"
+    # Async refresh only: background scoring threads. One is enough on the
+    # CPU smoke; more overlap more scoring forwards with the hot loop when
+    # host cores are spare.
+    scorer_workers: int = 1
+    # Async refresh only: snapshot the live params for the fleet every
+    # K steps. Smaller = fresher scores, more device copies; the staleness
+    # telemetry (sampler/score_staleness_*) shows where the knob sits.
+    snapshot_every: int = 16
+    # Async refresh only: minimum idle time (seconds) a scorer worker
+    # inserts between chunks. 0.0 = score continuously (max freshness —
+    # right when host cores/devices are spare). On core-constrained hosts
+    # (the CPU smoke runs on one core) a continuously-scoring fleet steals
+    # the compute the step needs; a throttle trades refresh rate for step
+    # time, and the table's age-decay absorbs the extra staleness.
+    scorer_throttle_s: float = 0.0
     # Optional dtype override for the SCORING forward only (scores only
     # rank, so bf16 scoring is safe even when training compute is f32) —
     # e.g. "bfloat16" halves the refresh forward's bandwidth. None = score

@@ -1,9 +1,9 @@
 """graftlint Layer 2: jaxpr/HLO structural auditor.
 
 Traces the fused Mercury train step (and its ZeRO / bf16-scoring /
-sequence-parallel / pipeline-parallel variants) on CPU — trace only, no
-compile, no execution — and checks *structural invariants of the traced
-program* as data:
+sequence-parallel / pipeline-parallel / async-scorer variants) on CPU —
+trace only, no compile, no execution — and checks *structural invariants
+of the traced program* as data:
 
 - **Collective budget**: exact per-primitive counts (psum, all_gather,
   reduce_scatter, ppermute, …) per parallelism plan, globally and inside
@@ -20,6 +20,11 @@ program* as data:
 - **bf16 scoring stays bf16**: with ``scoring_dtype="bfloat16"``, zero
   f32×f32 dot/conv ops inside the ``mercury_scoring`` scope (hard
   invariant — a silent upcast would erase the plan's FLOP savings).
+- **Async refresh carries no scoring**: with ``refresh_mode="async"``,
+  zero dot/conv ops and zero collectives inside ``mercury_scoring``
+  (hard invariant — the scorer fleet owns the refresh, so any scoring
+  compute in the hot program is the regression the mode exists to
+  remove).
 - **Seed-program digest**: the sha256 of the canonicalized jaxpr for
   ``telemetry=False`` must equal the committed digest, turning PR 2's
   compile-away benchmark claim into a checked invariant, and the dp
@@ -45,7 +50,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 SCHEMA = "graftlint_budgets_v1"
-PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "sp", "pp")
+PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "sp", "pp", "async")
 
 # The seed step's metric surface — what telemetry=False must reproduce
 # exactly (mirrors benchmarks/telemetry_overhead.py::BASE_KEYS).
@@ -152,6 +157,7 @@ class PlanMeasurement:
     donation_markers: int = 0
     expected_donated_args: int = 0
     f32_scoring_dots: int = 0
+    scoring_ops: int = 0
     jaxpr_sha256: str = ""
     metric_keys: List[str] = field(default_factory=list)
 
@@ -172,6 +178,7 @@ class PlanMeasurement:
             "host_callbacks": self.host_callbacks,
             "donation_markers": self.donation_markers,
             "f32_scoring_dots": self.f32_scoring_dots,
+            "scoring_ops": self.scoring_ops,
             "jaxpr_sha256": self.jaxpr_sha256,
             "metric_keys": self.metric_keys,
         }
@@ -204,6 +211,7 @@ def measure_step(step_fn, args: Tuple, plan: str,
             m.host_callbacks += 1
         if name in ("dot_general", "conv_general_dilated") \
                 and "mercury_scoring" in _name_stack(eqn):
+            m.scoring_ops += 1
             dtypes = _leaf_dtypes(eqn.invars)
             if dtypes and all(d == "float32" for d in dtypes):
                 m.f32_scoring_dots += 1
@@ -264,6 +272,46 @@ def _build_fused(variant: str):
     ds = trainer.dataset
     args = (trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
     return trainer.train_step, args, dict(kw, plan=variant)
+
+
+def _build_async():
+    """The async-scorer fused step (``refresh_mode="async"``): the
+    scoretable sampler with the refresh forward moved onto the host
+    scorer fleet. The traced program must carry ZERO scoring ops — that
+    is the feature's entire claim, so it is a hard invariant here, not
+    just a budget entry. The trainer's fleet is closed immediately: the
+    audit traces the step program, and a live background scorer would
+    burn CPU under every subsequent plan's trace."""
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    kw: Dict[str, Any] = dict(
+        model="smallcnn",
+        dataset="synthetic",
+        world_size=2,
+        batch_size=8,
+        presample_batches=2,
+        sampler="scoretable",
+        refresh_mode="async",
+        scorer_workers=1,
+        snapshot_every=4,
+        num_epochs=1,
+        steps_per_epoch=100,
+        eval_every=0,
+        log_every=0,
+        scan_steps=1,
+        compute_dtype="float32",
+        telemetry=False,
+        heartbeat_every=0,
+        seed=0,
+    )
+    config = TrainConfig(**kw)
+    trainer = Trainer(config, mesh=make_mesh(2, config.mesh_axis))
+    trainer._scorer_fleet.close()
+    ds = trainer.dataset
+    args = (trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+    return trainer.train_step, args, dict(kw, plan="async")
 
 
 def _build_hs():
@@ -380,6 +428,7 @@ _BUILDERS = {
     "hs": _build_hs,
     "sp": _build_sp,
     "pp": _build_pp,
+    "async": _build_async,
 }
 
 
@@ -411,6 +460,20 @@ def check_invariants(m: PlanMeasurement) -> List[str]:
             "inside the mercury_scoring scope with "
             "scoring_dtype=bfloat16 (expected 0: a silent upcast erases "
             "the scoring FLOP savings)")
+    if m.plan == "async":
+        if m.scoring_ops != 0:
+            errors.append(
+                f"plan async: {m.scoring_ops} dot/conv op(s) inside the "
+                "mercury_scoring scope with refresh_mode=async (expected "
+                "0: the scorer fleet owns the refresh — scoring compute "
+                "in the hot program is the regression this plan exists "
+                "to catch)")
+        if m.scoped_collectives.get("mercury_scoring"):
+            errors.append(
+                "plan async: collectives inside the mercury_scoring "
+                f"scope {m.scoped_collectives['mercury_scoring']} with "
+                "refresh_mode=async (expected none: no scoring forward, "
+                "no scoring collectives)")
     if m.donation_markers >= 0 and m.expected_donated_args == 0 \
             and m.donation_markers != 0:
         errors.append(
@@ -532,6 +595,10 @@ def compare_budgets(measurements: Sequence[PlanMeasurement],
                 f"  f32_scoring_dots expected "
                 f"{budget.get('f32_scoring_dots')}, got "
                 f"{m.f32_scoring_dots}")
+        if budget.get("scoring_ops", m.scoring_ops) != m.scoring_ops:
+            soft.append(
+                f"  scoring_ops expected {budget.get('scoring_ops')}, "
+                f"got {m.scoring_ops}")
         if soft:
             header = (f"plan {m.plan}: traced program deviates from "
                       "committed budget:")

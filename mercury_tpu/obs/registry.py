@@ -4,7 +4,7 @@ One flat ``key → one-line meaning`` dict, stdlib-only (graftlint's
 metric-key layer AST-parses this file without importing jax — keep it a
 pure literal plus trivial helpers). The registry is the contract between
 the emitters (``train/step.py``, ``train/trainer.py``, ``data/stream.py``,
-``obs/*``) and the consumers (sinks, dashboards, the anomaly engine,
+``sampling/scorer_fleet.py``, ``obs/*``) and the consumers (sinks, dashboards, the anomaly engine,
 ``docs/API.md``'s glossary): a key that is not here is a lint error, so a
 renamed or fat-fingered metric fails CI instead of silently forking the
 stream (``python -m mercury_tpu.lint --layer metrics``).
@@ -37,6 +37,12 @@ METRIC_KEYS: Dict[str, str] = {
     "sampler/table_age_min": "scoretable: youngest entry age (sweeps)",
     "sampler/table_age_mean": "scoretable: mean entry age (sweeps)",
     "sampler/table_age_max": "scoretable: oldest entry age (sweeps)",
+    "sampler/score_staleness_mean":
+        "async refresh: mean applied-chunk age (steps) since last tick",
+    "sampler/score_staleness_max":
+        "async refresh: oldest applied-chunk age (steps) since last tick",
+    "sampler/refresh_lag_chunks":
+        "async refresh: scored chunks queued but not yet applied",
     # perf/* — throughput accounting between log ticks
     "perf/steps_per_s": "steps per second since the previous log tick",
     "perf/examples_per_s": "examples per second since the previous log tick",
@@ -49,6 +55,8 @@ METRIC_KEYS: Dict[str, str] = {
     "data/stall_s": "input-attributable pop() wait since the last log tick",
     "data/queue_depth": "committed prefetch batches ready at log time",
     "data/h2d_bytes": "staged host-to-device bytes since the last log tick",
+    # scorer/* — the async scorer fleet (sampling/scorer_fleet.py)
+    "scorer/throughput": "async refresh: rows scored per second by the fleet",
     # obs/* — the metric stream observing itself
     "obs/dropped": "cumulative records dropped by the bounded queue",
     # anomaly/* — flight-recorder health accounting

@@ -8,10 +8,13 @@ from mercury_tpu.sampling.groupwise import (  # noqa: F401
 from mercury_tpu.sampling.scoretable import (  # noqa: F401
     ScoreTableState,
     advance_cursor,
+    apply_async_chunk,
     decay_scores,
     init_score_table,
     refresh_window,
     scatter_mean,
+    stale_weighted,
+    table_draw_inverse_cdf,
     table_probs,
     table_refresh_draw,
 )

@@ -1,0 +1,371 @@
+"""Background scorer fleet: importance refresh OFF the training step.
+
+``refresh_mode="async"`` (config.py) moves the scoretable sampler's
+round-robin refresh forward out of the fused step and onto this fleet —
+host threads that continuously re-score ``[W, refresh_size]`` shard
+chunks against a periodically-snapshotted copy of the model params and
+stream the resulting ``(slots, scores)`` chunks back through a bounded
+queue (the ``data/stream.py`` ``PrefetchPipeline`` idiom: daemon
+workers, blocking hand-off for backpressure, idempotent ``close()``,
+interval-delta ``stats()``). The trainer drains ready chunks between
+step dispatches and scatters them into the device-resident ``[W, L]``
+table with staleness-aware decay weighting
+(:func:`mercury_tpu.sampling.scoretable.apply_async_chunk`): a chunk
+scored ``a`` steps ago enters as ``μ + γ^a·(score − μ)`` — exactly the
+value it would carry had it been applied then and age-decayed since, so
+host-side refresh composes with the in-graph decay instead of fighting
+it.
+
+The design is the dedicated-scorer architecture of Alain et al.,
+*Variance Reduction in SGD by Distributed Importance Sampling*
+(arXiv:1511.06481) — scorers run on snapshot params and the sampler
+tolerates the staleness — with the bias/variance framing of Katharopoulos
+& Fleuret's biased-IS work (arXiv:1706.00043): the ``1/(L·p)`` reweight
+uses the probabilities the batch was ACTUALLY drawn with, so stale
+scores shift variance, never the mean.
+
+What the trainer gains: the compiled hot loop contains ZERO scoring
+FLOPs/collectives (the graftlint Layer-2/3 ``async`` plan budgets prove
+it), at the price of score ages measured in steps instead of zero.
+Telemetry: ``scorer/throughput``, ``sampler/refresh_lag_chunks``,
+``sampler/score_staleness_{mean,max}`` (obs/registry.py).
+
+Single-controller only, like the prefetch pipeline: the fleet scores
+from one host's copy of the dataset.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.data.pipeline import augment_batch, normalize_images
+from mercury_tpu.obs.trace import NULL_TRACER
+from mercury_tpu.sampling.importance import (
+    per_sample_grad_norm_bound,
+    per_sample_loss,
+)
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.sampling.scorer_fleet")
+
+
+class ScoreChunk(NamedTuple):
+    """One refreshed chunk: the same round-robin window for every worker
+    row (the in-graph refresh advances all ``W`` cursors in lockstep from
+    the same init, so a shared window preserves its coverage
+    semantics)."""
+
+    slots: np.ndarray   # [W, R] int32 shard-local slots
+    scores: np.ndarray  # [W, R] float32 fresh scores (unweighted)
+    step: int           # trainer step of the param snapshot that scored them
+
+
+class ScorerFleet:
+    """``scorer_workers`` daemon threads scoring round-robin shard chunks
+    against the latest param snapshot.
+
+    Lifecycle (driven by ``train/trainer.py``):
+
+    - :meth:`snapshot` — hand the fleet a COPY of the live params every
+      ``snapshot_every`` steps (the live state is donated into the next
+      step dispatch, so the copy is mandatory, not an optimization).
+    - :meth:`drain` — non-blocking: all chunks ready right now.
+    - :meth:`note_applied` — record the age of an applied chunk for the
+      staleness telemetry.
+    - :meth:`close` — idempotent shutdown; :meth:`reset` discards queued
+      chunks after a checkpoint restore (they scored the old trajectory).
+
+    Backpressure: the ready queue is bounded, and workers block pushing
+    into it — when the trainer isn't draining (between log ticks of a
+    fast hot loop) the fleet idles instead of burning host CPU the step
+    needs, which is what keeps the async arm's step time at the uniform
+    baseline (benchmarks/scoring_cost.py).
+    """
+
+    def __init__(
+        self,
+        x_train: np.ndarray,
+        y_train: np.ndarray,
+        shard_indices: np.ndarray,
+        model,
+        mean: np.ndarray,
+        std: np.ndarray,
+        config: TrainConfig,
+        tracer=None,
+    ) -> None:
+        self._x = np.asarray(x_train)
+        self._y = np.asarray(y_train)
+        self._shard_indices = np.asarray(shard_indices)
+        self._W, self._L = self._shard_indices.shape
+        self._R = int(config.refresh_size)
+        self._workers = int(config.scorer_workers)
+        self._throttle = float(config.scorer_throttle_s)
+        self._model = model
+        self._mean = mean
+        self._std = std
+        self._config = config
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+
+        if config.augmentation == "noniid":
+            self._augment = lambda k, im: augment_batch(
+                k, im, use_cutout=config.cutout)
+        elif config.augmentation == "iid":
+            from mercury_tpu.data.transforms import augment_batch_iid
+
+            self._augment = augment_batch_iid
+        else:
+            self._augment = lambda k, im: im
+
+        # Chunk-id-keyed augmentation stream, disjoint from the step's
+        # per-worker rng chains (the fleet's augmentation draws cannot
+        # perturb any recorded trajectory).
+        self._base_key = jax.random.fold_in(  # graftlint: disable=GL101 -- deliberate sentinel stream 0x5C0 for fleet-side augmentation, disjoint from the training rng chains
+            jax.random.key(config.seed), 0x5C0)
+        self._score_fn = self._build_score_fn()
+        # Identity jit: executable outputs are always fresh XLA-owned
+        # buffers (never aliases of the donated live state) — the same
+        # idiom as Trainer._recommit_state and PrefetchPipeline._commit.
+        self._copy = jax.jit(lambda t: t)
+
+        # (params, batch_stats, step) — replaced wholesale by snapshot();
+        # readers grab the tuple once, so torn reads are impossible.
+        self._snap: Optional[tuple] = None
+
+        self._lock = threading.Lock()
+        self._cursor = 0         # round-robin chunk start (shared, locked)
+        self._chunk_seq = 0      # augmentation-key counter
+        self._chunks_scored = 0
+        self._rows_scored = 0
+        self._applied_chunks = 0
+        self._snapshots = 0
+        self._ages: List[float] = []   # ages applied since the last stats()
+        self._tick_rows = 0
+        self._tick_t = time.perf_counter()
+
+        self._ready: "queue.Queue[ScoreChunk]" = queue.Queue(
+            maxsize=max(2 * self._workers, 2))
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True,
+                             name=f"mercury-scorer-{i}")
+            for i in range(self._workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------- scoring
+    def _build_score_fn(self):
+        config = self._config
+        model = self._model
+        mean, std = self._mean, self._std
+        n_workers = self._W
+
+        def score(params, batch_stats, rows, labels, key):
+            # vmap over the worker axis so batch statistics are computed
+            # per worker row — the same normalization granularity the
+            # in-graph per-worker scoring forward sees inside shard_map.
+            def one(rows_w, labels_w, key_w):
+                imgs = normalize_images(rows_w, mean, std)
+                imgs = self._augment(key_w, imgs)
+                variables = {"params": params}
+                mutable = ["losses"]
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                    mutable = ["batch_stats", "losses"]
+                logits, _ = model.apply(
+                    variables, imgs, train=True, mutable=mutable)
+                logits = logits.astype(jnp.float32)
+                if config.importance_score == "grad_norm":
+                    return per_sample_grad_norm_bound(
+                        logits, labels_w, config.label_smoothing)
+                return per_sample_loss(
+                    logits, labels_w, config.label_smoothing)
+
+            keys = jax.random.split(key, n_workers)
+            # The scope is profiler attribution only — this program is NOT
+            # the fused step, so the Layer-2/3 `async` plan budgets stay
+            # scoring-free; the device-time breakdown still buckets the
+            # fleet's forwards under mercury_scoring.
+            with jax.named_scope("mercury_scoring"):
+                return jax.vmap(one)(rows, labels, keys)
+
+        return jax.jit(score)
+
+    def _next_chunk(self) -> Optional[ScoreChunk]:
+        """Score the next round-robin window on the calling thread.
+        Public via :meth:`score_once`; the worker loop calls it too."""
+        snap = self._snap
+        if snap is None:
+            return None
+        params, batch_stats, snap_step = snap
+        with self._lock:
+            start = self._cursor
+            self._cursor = (start + self._R) % self._L
+            chunk_id = self._chunk_seq
+            self._chunk_seq += 1
+        slots = (start + np.arange(self._R)) % self._L        # [R]
+        gidx = self._shard_indices[:, slots]                  # [W, R]
+        rows = self._x[gidx]
+        labels = self._y[gidx]
+        key = jax.random.fold_in(self._base_key, chunk_id)  # graftlint: disable=GL101 -- chunk-id counter stream off the dedicated fleet base key
+        scores = self._score_fn(params, batch_stats, rows, labels, key)
+        # Device sync on the fleet thread — absorbing it off the trainer
+        # thread is the fleet's whole purpose.
+        scores_h = np.asarray(scores, np.float32)  # graftlint: disable=GL114 -- worker-side device sync: the fleet thread absorbs the fetch so the trainer never waits on scoring
+        with self._lock:
+            self._chunks_scored += 1
+            self._rows_scored += self._W * self._R
+        return ScoreChunk(
+            slots=np.broadcast_to(
+                slots.astype(np.int32), (self._W, self._R)).copy(),
+            scores=scores_h,
+            step=int(snap_step),
+        )
+
+    def score_once(self) -> ScoreChunk:
+        """Synchronously score the next chunk on the calling thread —
+        deterministic path for tests and debugging (no queue, no
+        threads involved)."""
+        chunk = self._next_chunk()
+        if chunk is None:
+            raise RuntimeError(
+                "scorer fleet has no param snapshot yet — call snapshot() "
+                "before score_once()")
+        return chunk
+
+    def _run(self, idx: int) -> None:
+        self._tracer.register_thread(f"scorer{idx}")
+        try:
+            while not self._closed:
+                if self._snap is None:
+                    time.sleep(0.005)
+                    continue
+                # "fleet/", not "scorer/": span names are not metric keys
+                # (the scorer/ prefix is registry-gated by graftlint
+                # Layer M).
+                with self._tracer.span("fleet/chunk", cat="scorer"):
+                    chunk = self._next_chunk()
+                if chunk is None:
+                    continue
+                # Blocking hand-off with a close() escape hatch: a full
+                # queue means the trainer is ahead of its drain cadence —
+                # idle here (backpressure) rather than stockpile chunks
+                # that would only grow staler.
+                while not self._closed:
+                    try:
+                        self._ready.put(chunk, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                # Duty-cycle throttle (scorer_throttle_s): cede the host
+                # core between chunks, in short slices so close() never
+                # waits out a long sleep.
+                deadline = time.perf_counter() + self._throttle
+                while not self._closed:
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    time.sleep(min(left, 0.05))
+        except BaseException as exc:  # surface on the next drain()
+            self._exc = exc
+            _log.warning("scorer worker %d died: %s: %s",
+                         idx, type(exc).__name__, exc)
+
+    # ----------------------------------------------------------- lifecycle
+    def snapshot(self, params, batch_stats, step: int) -> None:
+        """Install a fresh param snapshot for subsequent chunks.
+
+        COPIES via the identity jit: the caller's ``state`` is donated
+        into the very next step dispatch, so holding its buffers would
+        read freed memory — executable outputs are XLA-owned fresh
+        buffers. Async dispatch, no host sync: the trainer thread pays
+        one params-sized device copy every ``snapshot_every`` steps."""
+        snap_params, snap_stats = self._copy((params, batch_stats))
+        self._snap = (snap_params, snap_stats, int(step))
+        with self._lock:
+            self._snapshots += 1
+
+    def drain(self) -> List[ScoreChunk]:
+        """All chunks ready right now (non-blocking). Raises if a worker
+        died — a silently dead fleet would read as ever-growing staleness,
+        so failure is loud, matching the prefetch pipeline."""
+        if self._exc is not None:
+            raise RuntimeError("scorer fleet worker died") from self._exc
+        out: List[ScoreChunk] = []
+        while True:
+            try:
+                out.append(self._ready.get_nowait())
+            except queue.Empty:
+                return out
+
+    def note_applied(self, age: int) -> None:
+        """Record an applied chunk's age (steps between its snapshot and
+        its application) for the staleness telemetry."""
+        with self._lock:
+            self._applied_chunks += 1
+            self._ages.append(float(max(age, 0)))
+
+    def reset(self) -> None:
+        """Discard queued chunks (checkpoint restore: they scored the
+        previous trajectory's params). The caller re-snapshots after."""
+        while True:
+            try:
+                self._ready.get_nowait()
+            except queue.Empty:
+                break
+        with self._lock:
+            self._ages = []
+
+    def close(self) -> None:
+        """Idempotent shutdown: stop the workers and join them."""
+        if self._closed:
+            return
+        self._closed = True
+        for t in self._threads:
+            t.join(timeout=30.0)
+
+    # ----------------------------------------------------------- telemetry
+    def stats(self) -> Dict[str, float]:
+        """Interval-delta metrics for the log gate (host floats only —
+        no device sync). Keys are registered in obs/registry.py."""
+        now = time.perf_counter()
+        with self._lock:
+            rows = self._rows_scored - self._tick_rows
+            self._tick_rows = self._rows_scored
+            dt = max(now - self._tick_t, 1e-9)
+            self._tick_t = now
+            ages = self._ages
+            self._ages = []
+        return {
+            "scorer/throughput": rows / dt,
+            "sampler/refresh_lag_chunks": float(self._ready.qsize()),
+            "sampler/score_staleness_mean":
+                (sum(ages) / len(ages)) if ages else 0.0,
+            "sampler/score_staleness_max": max(ages) if ages else 0.0,
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """Cumulative counters for flight records
+        (``Trainer._flight_context``)."""
+        snap = self._snap
+        with self._lock:
+            return {
+                "workers": self._workers,
+                "chunk_shape": [self._W, self._R],
+                "chunks_scored": self._chunks_scored,
+                "rows_scored": self._rows_scored,
+                "chunks_applied": self._applied_chunks,
+                "snapshots": self._snapshots,
+                "snapshot_step": None if snap is None else int(snap[2]),
+                "queue_depth": self._ready.qsize(),
+                "closed": self._closed,
+            }

@@ -114,6 +114,35 @@ def scatter_mean(scores: jax.Array, slots: jax.Array,
     return jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), scores)
 
 
+def stale_weighted(values: jax.Array, ema_value: jax.Array,
+                   age_weight: jax.Array) -> jax.Array:
+    """Staleness-discount a refreshed chunk's scores toward the EMA mean:
+    ``w·value + (1−w)·μ`` with ``w = γ^age``.
+
+    This is :func:`decay_scores` applied ``age`` times to the fresh value
+    — a chunk scored ``age`` steps ago enters the table carrying exactly
+    the value it would have had had it been applied at age 0 and decayed
+    in-graph since, so the async fleet's host-side refresh composes with
+    the step's decay instead of fighting it. Written in the convex form
+    (not ``μ + w·(v − μ)``) so that ``age_weight == 1.0`` is BIT-exact
+    identity (``v·1.0 + μ·0.0 == v`` in IEEE-754), which is what lets
+    ``tests/test_async_refresh.py`` pin the async apply bit-identical to
+    the in-graph refresh at age 0."""
+    return values * age_weight + ema_value * (1.0 - age_weight)
+
+
+def apply_async_chunk(scores: jax.Array, slots: jax.Array,
+                      values: jax.Array, ema_value: jax.Array,
+                      age_weight: jax.Array) -> jax.Array:
+    """Scatter one async scorer-fleet chunk into the table:
+    staleness-weight the fresh ``values`` (:func:`stale_weighted`), then
+    write them through the SAME :func:`scatter_mean` the in-graph refresh
+    uses — the only difference between an async chunk at age 0 and the
+    in-graph refresh is who computed the scores."""
+    return scatter_mean(
+        scores, slots, stale_weighted(values, ema_value, age_weight))
+
+
 def table_probs(scores: jax.Array, ema_value: jax.Array,
                 alpha: float = 0.5) -> jax.Array:
     """Staleness-aware smoothing + normalization over the full table:
@@ -121,6 +150,24 @@ def table_probs(scores: jax.Array, ema_value: jax.Array,
     applies (``importance_probs``), over ``L`` slots instead of the
     pool."""
     return importance_probs(scores, ema_value, alpha)
+
+
+def table_draw_inverse_cdf(key: jax.Array, probs: jax.Array,
+                           batch_size: int) -> jax.Array:
+    """Draw ``batch_size`` slots with replacement by inverse-CDF on
+    ``batch_size`` uniforms — the Pallas kernel's draw strategy.
+
+    ``jax.random.categorical`` materializes a ``[B, L]`` Gumbel field
+    (``B·L`` threefry draws — ~5 ms at L≈3k on CPU, the entire async
+    step-time budget); inverse-CDF is ``O(L)`` cumsum + ``B`` uniforms +
+    a binary search, so the async step's draw costs like the uniform
+    sampler's. ``P(sel=i) = probs[i]/Σprobs`` exactly, so the
+    ``1/(L·p)`` reweight stays unbiased. Used by ``refresh_mode="async"``
+    only: the sync path keeps its committed categorical trajectory."""
+    cdf = jnp.cumsum(probs)
+    u = jax.random.uniform(key, (batch_size,)) * cdf[-1]
+    sel = jnp.searchsorted(cdf, u)
+    return jnp.clip(sel, 0, probs.shape[0] - 1).astype(jnp.int32)
 
 
 def table_refresh_draw(

@@ -56,6 +56,7 @@ from mercury_tpu.sampling.scoretable import (
     decay_scores,
     refresh_window,
     scatter_mean,
+    table_draw_inverse_cdf,
     table_probs,
     table_refresh_draw,
 )
@@ -283,6 +284,36 @@ def make_train_step(
             "scoring_dtype only affects the candidate-scoring forward; "
             "set use_importance_sampling=True (or drop scoring_dtype)"
         )
+    if config.refresh_mode not in ("sync", "async"):
+        raise ValueError(f"unknown refresh_mode {config.refresh_mode!r}")
+    # Async refresh: the round-robin scoring forward moves OFF the step and
+    # onto the host scorer fleet (sampling/scorer_fleet.py) — the traced
+    # branches below simply omit it, so the compiled hot program carries
+    # zero scoring FLOPs/collectives (the graftlint `async` plan budgets
+    # pin this down).
+    async_refresh = use_scoretable and config.refresh_mode == "async"
+    if config.refresh_mode == "async" and not use_scoretable:
+        raise ValueError(
+            "refresh_mode='async' requires sampler='scoretable' with "
+            "use_importance_sampling=True (the scorer fleet refreshes the "
+            "persistent score table; the pool/groupwise samplers have no "
+            f"table to stream into) — got sampler={config.sampler!r}, "
+            f"use_importance_sampling={use_is}"
+        )
+    if async_refresh:
+        if int(config.scorer_workers) < 1:
+            raise ValueError(
+                f"scorer_workers must be >= 1, got {config.scorer_workers}"
+            )
+        if int(config.snapshot_every) < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {config.snapshot_every}"
+            )
+        if float(config.scorer_throttle_s) < 0:
+            raise ValueError(
+                "scorer_throttle_s must be >= 0, got "
+                f"{config.scorer_throttle_s}"
+            )
 
     if config.importance_score not in ("loss", "grad_norm"):
         raise ValueError(
@@ -338,8 +369,11 @@ def make_train_step(
             )
     # Streamed rows per worker per step: the candidate pool for the pool
     # sampler (selection happens in-step on the streamed rows), the
-    # refresh window + the pre-drawn train batch for the scoretable one.
-    emit_size = ((refresh_size + batch_size) if use_scoretable
+    # refresh window + the pre-drawn train batch for the scoretable one —
+    # train rows only under async refresh (the fleet scores its own
+    # windows host-side, so no refresh rows ever cross the stream).
+    emit_size = (batch_size if async_refresh
+                 else (refresh_size + batch_size) if use_scoretable
                  else pool_size)
 
     def _loss_per_sample(logits, labels):
@@ -805,47 +839,103 @@ def make_train_step(
             # step drop from pool_size to refresh_size while the draw sees
             # every sample — vs. the pool sampler's fresh-320 window.
             table = jax.tree_util.tree_map(lambda x: x[0], state.scoretable)
-            refresh_slots = refresh_window(table, refresh_size)
-            _, r_labels, r_logits, r_scores = score_slots(
-                refresh_slots, k_aug
-            )
-            score_avg = pool_mean(r_scores, stat_axis)
-            ema_prev = ema.value
-            ema = ema_update(ema, score_avg, config.ema_alpha)
-            if use_pallas:
-                from mercury_tpu.ops import table_refresh_draw_pallas
+            if async_refresh:
+                # --- refresh_mode="async": no refresh window, no scoring
+                # forward, no mercury_scoring scope — the scorer fleet
+                # refreshed the table between dispatches. The in-graph work
+                # is decay → normalize → draw only; the post-train
+                # write-back below still re-scores the trained batch for
+                # free (those logits exist either way).
+                if use_pallas:
+                    from mercury_tpu.ops import table_refresh_draw_pallas
 
-                new_scores, _, selected, scaled_probs = (
-                    table_refresh_draw_pallas(
-                        k_sel, table.scores, refresh_slots, r_scores,
-                        ema.value, batch_size,
-                        alpha=config.is_alpha, decay=config.table_decay,
+                    # Dummy-slot sentinel: "refresh" slot 0 with its own
+                    # decayed value — scatter_mean writes back the number
+                    # the decay already produced, a no-op — so the SAME
+                    # fused decay→scatter→normalize→draw kernel serves the
+                    # async step with no scoring forward attached and no
+                    # second kernel to maintain.
+                    sent = (ema.value
+                            + (table.scores[0].astype(jnp.float32)
+                               - ema.value) * config.table_decay)[None]
+                    new_scores, _, selected, scaled_probs = (
+                        table_refresh_draw_pallas(
+                            k_sel, table.scores,
+                            jnp.zeros((1,), jnp.int32), sent,
+                            ema.value, batch_size,
+                            alpha=config.is_alpha, decay=config.table_decay,
+                        )
                     )
-                )
+                else:
+                    new_scores = decay_scores(
+                        table.scores.astype(jnp.float32), ema.value,
+                        config.table_decay,
+                    )
+                    probs = table_probs(
+                        new_scores, ema.value, config.is_alpha
+                    )
+                    # Inverse-CDF, not categorical: a [B, L] Gumbel field
+                    # is B·L threefry draws — at shard scale that alone
+                    # would cost more than the scoring forward we just
+                    # removed (measured ~5 ms at L≈3k on CPU).
+                    selected = table_draw_inverse_cdf(
+                        k_sel, probs, batch_size
+                    )
+                    scaled_probs = probs[selected] * new_scores.shape[0]
+                # No refresh forward → no pool-loss measurement this step;
+                # the EMA update moves post-train (see the write-back).
+                avg_pool_loss = jnp.zeros((), jnp.float32)
             else:
-                new_scores, _, selected, scaled_probs = table_refresh_draw(
-                    k_sel, table.scores, refresh_slots, r_scores,
-                    ema.value, batch_size,
-                    alpha=config.is_alpha, decay=config.table_decay,
+                refresh_slots = refresh_window(table, refresh_size)
+                _, r_labels, r_logits, r_scores = score_slots(
+                    refresh_slots, k_aug
+                )
+                score_avg = pool_mean(r_scores, stat_axis)
+                ema_prev = ema.value
+                ema = ema_update(ema, score_avg, config.ema_alpha)
+                if use_pallas:
+                    from mercury_tpu.ops import table_refresh_draw_pallas
+
+                    new_scores, _, selected, scaled_probs = (
+                        table_refresh_draw_pallas(
+                            k_sel, table.scores, refresh_slots, r_scores,
+                            ema.value, batch_size,
+                            alpha=config.is_alpha, decay=config.table_decay,
+                        )
+                    )
+                else:
+                    new_scores, _, selected, scaled_probs = (
+                        table_refresh_draw(
+                            k_sel, table.scores, refresh_slots, r_scores,
+                            ema.value, batch_size,
+                            alpha=config.is_alpha, decay=config.table_decay,
+                        )
+                    )
+                avg_pool_loss = _pool_loss_metric(
+                    r_logits, r_labels, score_avg
                 )
             sel_raw, sel_labels = gather_train(selected)
             sel_images = _augment(
                 k_aug2, normalize_images(sel_raw, mean, std)
             )
-            avg_pool_loss = _pool_loss_metric(r_logits, r_labels, score_avg)
             table_scores_predraw = new_scores
             table_selected = selected
             if telemetry:
-                # Clip over the FULL refreshed table — the distribution the
-                # draw actually normalizes — and staleness from the
-                # round-robin cursor (pre-advance: this window is age 0).
+                # Clip over the FULL refreshed (async: decayed) table — the
+                # distribution the draw actually normalizes.
                 clip_frac = clip_fraction(
                     new_scores, ema.value, config.is_alpha
                 )
-                drift = ema_drift(score_avg, ema_prev)
-                age_min, age_mean, age_max = table_age_summary(
-                    table.cursor, table.scores.shape[0], refresh_size
-                )
+                if not async_refresh:
+                    # Cursor staleness from the round-robin window
+                    # (pre-advance: this window is age 0); under async the
+                    # fleet owns the sweep, so ages live host-side
+                    # (sampler/score_staleness_* via ScorerFleet.stats) and
+                    # drift moves to the post-train EMA update below.
+                    drift = ema_drift(score_avg, ema_prev)
+                    age_min, age_mean, age_max = table_age_summary(
+                        table.cursor, table.scores.shape[0], refresh_size
+                    )
         else:
             if use_groupwise:
                 # Sliding-window refresh over the shard (util.py:114-138):
@@ -930,11 +1020,27 @@ def make_train_step(
             train_scores = _score_per_sample(
                 logits.astype(jnp.float32), sel_labels
             )
+            if async_refresh:
+                # With no refresh forward, the EMA mean (decay target and
+                # smoothing anchor) comes from the trained batch itself,
+                # reweighted back to the uniform-mean estimate:
+                # E[score_i/(L·p_i)] = mean_L(score) — the same unbiased
+                # identity the loss reweighting rests on — so the EMA
+                # tracks the SHARD-typical score, not the importance-tilted
+                # batch mean, at zero extra FLOPs.
+                score_avg = pool_mean(train_scores / scaled_probs, stat_axis)
+                ema_prev = ema.value
+                ema = ema_update(ema, score_avg, config.ema_alpha)
+                if telemetry:
+                    drift = ema_drift(score_avg, ema_prev)
             new_table = ScoreTableState(
                 scores=scatter_mean(
                     table_scores_predraw, table_selected, train_scores
                 ),
-                cursor=advance_cursor(table, refresh_size),
+                # Async: the fleet owns the round-robin sweep — the
+                # in-graph cursor stays put.
+                cursor=(table.cursor if async_refresh
+                        else advance_cursor(table, refresh_size)),
             )
             new_scoretable = jax.tree_util.tree_map(
                 lambda x: x[None], new_table
@@ -977,9 +1083,11 @@ def make_train_step(
             metrics["sampler/clip_frac"] = lax.pmean(clip_frac, axis)
             metrics["sampler/ema_drift"] = lax.pmean(drift, axis)
             metrics["train/grad_norm"] = grad_norm
-            if use_scoretable:
+            if use_scoretable and not async_refresh:
                 # Cursor-derived, identical on every worker (the cursors
-                # advance in lockstep from the same init).
+                # advance in lockstep from the same init). Async has no
+                # in-graph cursor motion — staleness is tracked host-side
+                # (sampler/score_staleness_*).
                 metrics["sampler/table_age_min"] = age_min
                 metrics["sampler/table_age_mean"] = age_mean
                 metrics["sampler/table_age_max"] = age_max
@@ -1019,40 +1127,60 @@ def make_train_step(
             drift = jnp.zeros((), jnp.float32)
 
         if use_scoretable:
-            # Streamed layout: rows 0:R are the step-t refresh window
-            # (deterministic round-robin — drawn without the table),
-            # rows R: are the train rows selected depth steps ago.
-            refresh_slots = front[:refresh_size]
-            train_slots = front[refresh_size:]
-            with jax.named_scope("mercury_scoring"):
-                r_labels = y_train[shard_indices[0][refresh_slots]]
-                _, r_logits, r_scores = score_rows(
-                    state, xs[:refresh_size], r_labels, k_aug
-                )
-            score_avg = pool_mean(r_scores, stat_axis)
-            ema_prev = ema.value
-            ema = ema_update(ema, score_avg, config.ema_alpha)
             table = jax.tree_util.tree_map(lambda x: x[0], state.scoretable)
-            # Same decay → refresh-scatter as table_refresh_draw; the draw
-            # half ran depth steps ago, so only the table update remains.
-            refreshed = scatter_mean(
-                decay_scores(
+            if async_refresh:
+                # Async: the stream carries ONLY the train rows (the fleet
+                # owns the refresh sweep host-side — no refresh rows ever
+                # cross the pipeline, no in-graph scoring forward). The
+                # table still age-decays; the EMA update moves post-train.
+                train_slots = front
+                refreshed = decay_scores(
                     table.scores.astype(jnp.float32), ema.value,
                     config.table_decay,
-                ),
-                refresh_slots, r_scores,
-            )
-            sel_labels = y_train[shard_indices[0][train_slots]]
-            sel_images = _augment(
-                k_aug2, normalize_images(xs[refresh_size:], mean, std)
-            )
-            scaled_probs = psel.scaled_probs[0]
-            avg_pool_loss = _pool_loss_metric(r_logits, r_labels, score_avg)
-            if telemetry:
-                drift = ema_drift(score_avg, ema_prev)
-                age_min, age_mean, age_max = table_age_summary(
-                    table.cursor, table.scores.shape[0], refresh_size
                 )
+                sel_labels = y_train[shard_indices[0][train_slots]]
+                sel_images = _augment(
+                    k_aug2, normalize_images(xs, mean, std)
+                )
+                scaled_probs = psel.scaled_probs[0]
+                avg_pool_loss = jnp.zeros((), jnp.float32)
+            else:
+                # Streamed layout: rows 0:R are the step-t refresh window
+                # (deterministic round-robin — drawn without the table),
+                # rows R: are the train rows selected depth steps ago.
+                refresh_slots = front[:refresh_size]
+                train_slots = front[refresh_size:]
+                with jax.named_scope("mercury_scoring"):
+                    r_labels = y_train[shard_indices[0][refresh_slots]]
+                    _, r_logits, r_scores = score_rows(
+                        state, xs[:refresh_size], r_labels, k_aug
+                    )
+                score_avg = pool_mean(r_scores, stat_axis)
+                ema_prev = ema.value
+                ema = ema_update(ema, score_avg, config.ema_alpha)
+                # Same decay → refresh-scatter as table_refresh_draw; the
+                # draw half ran depth steps ago, so only the table update
+                # remains.
+                refreshed = scatter_mean(
+                    decay_scores(
+                        table.scores.astype(jnp.float32), ema.value,
+                        config.table_decay,
+                    ),
+                    refresh_slots, r_scores,
+                )
+                sel_labels = y_train[shard_indices[0][train_slots]]
+                sel_images = _augment(
+                    k_aug2, normalize_images(xs[refresh_size:], mean, std)
+                )
+                scaled_probs = psel.scaled_probs[0]
+                avg_pool_loss = _pool_loss_metric(
+                    r_logits, r_labels, score_avg
+                )
+                if telemetry:
+                    drift = ema_drift(score_avg, ema_prev)
+                    age_min, age_mean, age_max = table_age_summary(
+                        table.cursor, table.scores.shape[0], refresh_size
+                    )
         elif use_is:
             # Pool sampler: the streamed rows ARE the candidate pool drawn
             # depth steps ago with rng_t's stream key; scoring + selection
@@ -1099,23 +1227,49 @@ def make_train_step(
             train_scores = _score_per_sample(
                 logits.astype(jnp.float32), sel_labels
             )
+            if async_refresh:
+                # Post-train EMA from the reweighted trained batch — the
+                # same unbiased mean_L estimate as the device-resident
+                # async body (see there) — BEFORE the lookahead normalize
+                # so the next draw smooths against the freshest mean.
+                score_avg = pool_mean(train_scores / scaled_probs, stat_axis)
+                ema_prev = ema.value
+                ema = ema_update(ema, score_avg, config.ema_alpha)
+                if telemetry:
+                    drift = ema_drift(score_avg, ema_prev)
             table_after = scatter_mean(refreshed, train_slots, train_scores)
             n_slots = table_after.shape[0]
             probs_next = table_probs(table_after, ema.value, config.is_alpha)
-            next_sel = draw_with_replacement(
-                sel_ks[2], probs_next, batch_size
-            ).astype(jnp.int32)
+            if async_refresh:
+                # Inverse-CDF draw, matching the device-resident async
+                # body: categorical's [B, L] Gumbel field would put the
+                # removed scoring forward's cost right back on the step.
+                next_sel = table_draw_inverse_cdf(
+                    sel_ks[2], probs_next, batch_size
+                )
+            else:
+                next_sel = draw_with_replacement(
+                    sel_ks[2], probs_next, batch_size
+                ).astype(jnp.int32)
             next_scaled = probs_next[next_sel] * n_slots
-            # The refresh window for step t+depth is cursor-deterministic:
-            # depth more R-sized round-robin advances from here.
-            next_window = (
-                (table.cursor + depth * refresh_size
-                 + jnp.arange(refresh_size)) % n_slots
-            ).astype(jnp.int32)
-            next_slots = jnp.concatenate([next_window, next_sel])
+            if async_refresh:
+                # No window rows in the stream — the lookahead emits the
+                # train draw only, and the cursor stays put (the fleet
+                # owns the sweep).
+                next_slots = next_sel
+            else:
+                # The refresh window for step t+depth is
+                # cursor-deterministic: depth more R-sized round-robin
+                # advances from here.
+                next_window = (
+                    (table.cursor + depth * refresh_size
+                     + jnp.arange(refresh_size)) % n_slots
+                ).astype(jnp.int32)
+                next_slots = jnp.concatenate([next_window, next_sel])
             new_table = ScoreTableState(
                 scores=table_after,
-                cursor=advance_cursor(table, refresh_size),
+                cursor=(table.cursor if async_refresh
+                        else advance_cursor(table, refresh_size)),
             )
             new_scoretable = jax.tree_util.tree_map(
                 lambda x: x[None], new_table
@@ -1175,7 +1329,7 @@ def make_train_step(
             metrics["sampler/clip_frac"] = lax.pmean(clip_frac, axis)
             metrics["sampler/ema_drift"] = lax.pmean(drift, axis)
             metrics["train/grad_norm"] = grad_norm
-            if use_scoretable:
+            if use_scoretable and not async_refresh:
                 metrics["sampler/table_age_min"] = age_min
                 metrics["sampler/table_age_mean"] = age_mean
                 metrics["sampler/table_age_max"] = age_max
@@ -1294,7 +1448,9 @@ def make_host_stream_prime(config: TrainConfig, mesh: Mesh):
     pool_size = int(config.candidate_pool_size) if use_is else int(
         config.batch_size)
     refresh_size = int(config.refresh_size)
-    emit_size = ((refresh_size + batch_size) if use_scoretable
+    async_refresh = use_scoretable and config.refresh_mode == "async"
+    emit_size = (batch_size if async_refresh
+                 else (refresh_size + batch_size) if use_scoretable
                  else pool_size)
 
     def prime(state: MercuryState, shard_indices):
@@ -1309,17 +1465,26 @@ def make_host_stream_prime(config: TrainConfig, mesh: Mesh):
                     lambda x: x[0], state.scoretable
                 )
                 n = table.scores.shape[0]
-                window = (
-                    (table.cursor + i * refresh_size
-                     + jnp.arange(refresh_size)) % n
-                ).astype(jnp.int32)
                 # Uniform-with-replacement through the SAME draw kernel the
                 # steady state uses, on the flat distribution — consumes
                 # k_sel exactly as hs_body's lookahead will.
-                drawn = draw_with_replacement(
-                    ks[2], jnp.full((n,), 1.0 / n, jnp.float32), batch_size
-                ).astype(jnp.int32)
-                slots_i = jnp.concatenate([window, drawn])
+                flat = jnp.full((n,), 1.0 / n, jnp.float32)
+                if async_refresh:
+                    drawn = table_draw_inverse_cdf(ks[2], flat, batch_size)
+                else:
+                    drawn = draw_with_replacement(
+                        ks[2], flat, batch_size
+                    ).astype(jnp.int32)
+                if async_refresh:
+                    # Async streams train rows only (the fleet owns the
+                    # refresh sweep) — no window rows to prime.
+                    slots_i = drawn
+                else:
+                    window = (
+                        (table.cursor + i * refresh_size
+                         + jnp.arange(refresh_size)) % n
+                    ).astype(jnp.int32)
+                    slots_i = jnp.concatenate([window, drawn])
             else:
                 stream, slots_i = next_pool(stream, ks[0], emit_size)
                 slots_i = slots_i.astype(jnp.int32)

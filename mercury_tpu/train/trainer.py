@@ -613,6 +613,53 @@ class Trainer:
             # lazy fill — mfu reports 0.0, steps/s and examples/s remain.
             self._flops_known = True
 
+        # --- async scorer fleet (refresh_mode="async"): background host
+        # threads continuously re-score round-robin shard chunks against a
+        # periodically-snapshotted copy of the params and stream (slots,
+        # scores) chunks into the device table between step dispatches
+        # (sampling/scorer_fleet.py; drained by _async_refresh_tick in the
+        # fit loop). Built BEFORE auto_resume: a restore resets the fleet
+        # via _recommit_state (queued chunks scored the old trajectory).
+        self._scorer_fleet = None
+        if (config.use_importance_sampling
+                and config.sampler == "scoretable"
+                and config.refresh_mode == "async"):
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "refresh_mode='async' is single-controller only: the "
+                    "scorer fleet scores from one host's copy of the "
+                    "dataset (like data_placement='host_stream')"
+                )
+            from mercury_tpu.sampling.scorer_fleet import ScorerFleet
+
+            # The fleet's scoring forwards run OUTSIDE shard_map, where
+            # the mesh data axis doesn't exist — build a local-BN scorer
+            # clone (params are shared; flax modules are layout, not
+            # weights). scoring_dtype applies, as it would in-graph.
+            fleet_model = create_model(
+                config.model,
+                num_classes=self.dataset.num_classes,
+                compute_dtype=config.scoring_dtype or config.compute_dtype,
+                param_dtype=config.param_dtype,
+                bn_axis_name=None,
+                **model_kw,
+            )
+            self._scorer_fleet = ScorerFleet(
+                np.asarray(self.dataset.x_train),
+                np.asarray(self.dataset.y_train),
+                np.asarray(self.dataset.shard_indices),
+                fleet_model,
+                self.dataset.mean,
+                self.dataset.std,
+                config,
+                tracer=self.tracer,
+            )
+            self._apply_refresh = self._make_refresh_apply()
+            self._scorer_fleet.snapshot(
+                self.state.params, self.state.batch_stats,
+                step=int(self.state.step),
+            )
+
         # Crash/preemption recovery: pick up the newest checkpoint, sampler
         # state included (bit-deterministic IS resume). The NEXT fit() then
         # runs to the ORIGINAL end step, not num_epochs more (see fit) —
@@ -669,6 +716,10 @@ class Trainer:
         batch for the scoretable one, the batch itself for uniform."""
         cfg = self.config
         if cfg.use_importance_sampling and cfg.sampler == "scoretable":
+            if cfg.refresh_mode == "async":
+                # Async streams only the train rows — the scorer fleet
+                # owns the refresh sweep host-side.
+                return int(cfg.batch_size)
             return int(cfg.refresh_size) + int(cfg.batch_size)
         if cfg.use_importance_sampling:
             return int(cfg.candidate_pool_size)
@@ -713,6 +764,62 @@ class Trainer:
                 ])
                 self._stream_pipe.push(gidx)
 
+    # --------------------------------------------------- async scorer fleet
+    def _make_refresh_apply(self):
+        """Jitted ``[W]``-vmapped chunk scatter for the async fleet
+        (``apply_async_chunk`` per worker row), output pinned to the
+        scoretable's data-axis layout so applying a chunk never perturbs
+        the step's committed state sharding (jit-cache stability)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from mercury_tpu.sampling.scoretable import (
+            ScoreTableState,
+            apply_async_chunk,
+        )
+
+        sh = NamedSharding(self.mesh, P(self.config.mesh_axis))
+
+        def apply(tab, ema_value, slots, values, weight):
+            new_scores = jax.vmap(
+                apply_async_chunk, in_axes=(0, 0, 0, 0, None)
+            )(tab.scores, slots, values, ema_value, weight)
+            return tab._replace(scores=new_scores)
+
+        return jax.jit(
+            apply,
+            out_shardings=ScoreTableState(scores=sh, cursor=sh),
+        )
+
+    def _async_refresh_tick(self, step: int, advanced: int = 1) -> None:
+        """Per-iteration fleet service: scatter every ready chunk into the
+        device score table (staleness-weighted by ``table_decay**age``,
+        the exact in-graph decay an age-0 apply would have accrued) and
+        re-snapshot the params on the ``snapshot_every`` cadence. Host
+        ints only — no device sync ever happens on this thread."""
+        fleet = self._scorer_fleet
+        if fleet is None:
+            return
+        chunks = fleet.drain()
+        if chunks:
+            with self.tracer.span("trainer/apply_refresh", cat="trainer",
+                                  chunks=len(chunks)):
+                for chunk in chunks:
+                    age = max(step - chunk.step, 0)
+                    weight = jnp.float32(self.config.table_decay ** age)
+                    new_tab = self._apply_refresh(
+                        self.state.scoretable, self.state.ema.value,
+                        jnp.asarray(chunk.slots), jnp.asarray(chunk.scores),
+                        weight,
+                    )
+                    self.state = self.state.replace(scoretable=new_tab)
+                    fleet.note_applied(age)
+        every = int(self.config.snapshot_every)
+        if (step // every) > ((step - advanced) // every):
+            # The identity-jit inside snapshot() copies — the live state
+            # is donated into the next dispatch, so the fleet must never
+            # hold its buffers.
+            fleet.snapshot(self.state.params, self.state.batch_stats, step)
+
     # ---------------------------------------------------------- flight data
     def _flight_context(self) -> Dict[str, Any]:
         """Run context for flight-record dumps (obs/anomaly.py) —
@@ -724,6 +831,9 @@ class Trainer:
         pipe = getattr(self, "_stream_pipe", None)
         if pipe is not None:
             ctx["pipeline"] = pipe.summary()
+        fleet = getattr(self, "_scorer_fleet", None)
+        if fleet is not None:
+            ctx["scorer_fleet"] = fleet.summary()
         return ctx
 
     # ------------------------------------------------------------------ fit
@@ -789,6 +899,11 @@ class Trainer:
                             self.dataset.shard_indices,
                         )
                 step += k
+                if self._scorer_fleet is not None:
+                    # Scatter ready async-refresh chunks and re-snapshot on
+                    # cadence — host bookkeeping + async device dispatches,
+                    # nothing here waits on the step.
+                    self._async_refresh_tick(step, advanced=k)
                 if self.anomaly is not None:
                     self.anomaly.observe_step_time(
                         step, time.perf_counter() - t_iter, steps=k)
@@ -835,6 +950,10 @@ class Trainer:
                             # the last log): no device sync, safe to
                             # merge here.
                             record.update(self._stream_pipe.stats())
+                        if self._scorer_fleet is not None:
+                            # Same contract: host counters only
+                            # (scorer/throughput, staleness, lag).
+                            record.update(self._scorer_fleet.stats())
                         record["epoch"] = (step - 1) // self.steps_per_epoch
                         if self._crosshost_gather is not None:
                             # allgather mode: EVERY process participates
@@ -956,23 +1075,40 @@ class Trainer:
                          type(exc).__name__, exc)
 
     def close(self) -> None:
-        """Drain and close the metric writer and the prefetch pipeline,
-        stop any armed profiler capture, and export the span trace
-        (idempotent). A trainer also works as a context manager:
-        ``with Trainer(cfg) as t: t.fit()``."""
+        """Shut down the trainer's background subsystems — scorer fleet,
+        prefetch pipeline, armed profiler, span-trace export, metric
+        writer — in dependency order: producers (threads that can still
+        emit work or spans) stop before the sinks they feed.
+
+        Idempotent (a second call is a no-op — the subsystems' own
+        ``close()`` methods tolerate repeats, and the ``_closed`` latch
+        skips the trace re-export) and safe on partially-constructed
+        trainers: every attribute access is guarded, so a constructor
+        that raised halfway still closes cleanly
+        (``tests/test_async_refresh.py`` pins both). A trainer also works
+        as a context manager: ``with Trainer(cfg) as t: t.fit()``."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        fleet = getattr(self, "_scorer_fleet", None)
+        if fleet is not None:
+            fleet.close()
         if getattr(self, "_stream_pipe", None) is not None:
             self._stream_pipe.close()
         if getattr(self, "_profiling", False):
             self._stop_profiler()
         tracer = getattr(self, "tracer", None)
-        if (tracer is not None and tracer.enabled and self.config.log_dir
-                and jax.process_index() == 0):
+        config = getattr(self, "config", None)
+        if (tracer is not None and tracer.enabled and config is not None
+                and config.log_dir and jax.process_index() == 0):
             try:
                 tracer.export_chrome_trace(
-                    os.path.join(self.config.log_dir, "trace.json"))
+                    os.path.join(config.log_dir, "trace.json"))
             except Exception as exc:
                 _log.warning("trace export failed: %s", exc)
-        self.logger.close()
+        logger = getattr(self, "logger", None)
+        if logger is not None:
+            logger.close()
 
     def __enter__(self) -> "Trainer":
         return self
@@ -1174,6 +1310,14 @@ class Trainer:
         # The restored pending_sel ring defines steps t..t+depth-1's
         # selections; re-seed the prefetch pipeline with their rows.
         self._refill_stream_pipe()
+        # Async fleet: queued chunks scored the pre-restore trajectory —
+        # discard them and re-snapshot from the restored params (a restore
+        # is already a sync point, so the int() here costs nothing new).
+        fleet = getattr(self, "_scorer_fleet", None)
+        if fleet is not None:
+            fleet.reset()
+            fleet.snapshot(self.state.params, self.state.batch_stats,
+                           int(self.state.step))
 
     def restore_elastic(self, directory: Optional[str] = None,
                         step: Optional[int] = None, raw=None) -> int:
