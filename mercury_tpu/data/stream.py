@@ -38,6 +38,10 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.data.stream")
+
 __all__ = ["HostStreamSource", "ImageFolderSource", "PrefetchPipeline"]
 
 
@@ -275,6 +279,7 @@ class PrefetchPipeline:
             "data/stall_s": stall,
             "data/queue_depth": float(self._ready.qsize()),
             "data/h2d_bytes": float(h2d),
+            "threads/queue_depth/prefetch": float(self._ready.qsize()),
         }
 
     def summary(self) -> Dict[str, float]:
@@ -306,17 +311,40 @@ class PrefetchPipeline:
             except queue.Empty:
                 return
 
-    def close(self) -> None:
+    def close(self, timeout: float = 30.0) -> None:
         if self._closed:
             return
         self._closed = True
         self._work.put(_STOP)
-        self._thread.join(timeout=30.0)
+        # The worker may be parked in _publish waiting for ready-queue
+        # room; draining the committed batches gives it space to notice
+        # _closed and exit instead of riding out its timeout slices.
+        self._drain(self._ready)
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            _log.warning(
+                "prefetch thread %r still alive %.0fs after close() — "
+                "abandoning it wedged (daemon)",
+                self._thread.name, timeout)
         close = getattr(self.source, "close", None)
         if close is not None:
             close()
 
     # -------------------------------------------------------------- worker
+    def _publish(self, item) -> bool:
+        """Bounded-wait put onto the ready queue with a close() escape
+        hatch: a full queue means the trainer is behind — wait for room
+        in short slices so a shutdown never wedges the producer against
+        a queue nobody will drain again. Returns False when the
+        pipeline closed before the item could be published."""
+        while not self._closed:
+            try:
+                self._ready.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _prefetch_loop(self) -> None:
         import jax
 
@@ -360,8 +388,8 @@ class PrefetchPipeline:
                 # consuming step serializes behind naturally — blocking on
                 # it here would charge device-queue time as stall. The
                 # host lag rides along for pop()'s stall attribution.
-                self._ready.put((batch, time.monotonic() - t_ready))
+                self._publish((batch, time.monotonic() - t_ready))
             except BaseException as exc:  # surfaced on the next pop()
                 self._exc = exc
-                self._ready.put(_FAILED)
+                self._publish(_FAILED)
                 return

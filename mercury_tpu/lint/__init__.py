@@ -1,6 +1,6 @@
 """graftlint: static analysis for the JAX hazards this codebase lives with.
 
-Two layers, one entry point (``python -m mercury_tpu.lint``):
+Four layers, one entry point (``python -m mercury_tpu.lint``):
 
 - **Layer 1** (:mod:`mercury_tpu.lint.rules`, :mod:`mercury_tpu.lint.engine`)
   is an AST rule engine over the package's own source with JAX-specific
@@ -38,6 +38,22 @@ Two layers, one entry point (``python -m mercury_tpu.lint``):
   in Layer 1 (unconstrained pjit output, bare ``device_put`` in hot
   modules, manual ``all_gather`` in auto regions, mesh-axis literals
   off the ``parallel/mesh.py`` registry).
+
+- **Layer C** (:mod:`mercury_tpu.lint.concurrency`,
+  :mod:`mercury_tpu.lint.racecheck`) audits the *host thread fleet* the
+  traced program rides on: an AST pass over the hot threaded modules
+  builds per-class thread-entry-point maps and infers each attribute's
+  lock discipline, flagging GL120–GL125 (unguarded cross-thread state,
+  queue put/get discipline, unjoined non-daemon threads, lock-order
+  deadlocks, blocking calls under a lock, and threads/pools/queues not
+  declared in the committed ``lint/thread_manifest.json`` —
+  ``--layer concurrency --regen`` parity). The runtime side is a
+  stdlib "TSan-lite": instrumented Lock/Queue wrappers plus a
+  monkeypatching :class:`~mercury_tpu.lint.racecheck.RaceMonitor` that
+  records cross-thread unsynchronized attribute access during stress
+  tests, and a :class:`~mercury_tpu.lint.racecheck.ThreadLeakGuard`
+  behind the conftest-wide thread-leak fixture. Pure stdlib, like
+  Layer 1.
 
 See ``docs/LINT.md`` for the rule catalog and ``docs/DESIGN.md`` for the
 audit invariants.

@@ -15,8 +15,13 @@ Layer selection:
 - ``--layer sharding``: Layer 3 — AOT-lower + compile each plan on the
   CPU mesh and verify the sharding/memory invariants against the
   committed ``lint/shard_budgets.json`` (``--regen`` parity).
+- ``--layer concurrency``: Layer C — static host-concurrency audit
+  (GL120–GL125) over the hot thread modules plus thread-manifest parity
+  against the committed ``lint/thread_manifest.json`` (``--regen`` to
+  re-record after an intentional fleet change). Pure stdlib.
 - ``--layer all``: all of the above. With ``--diff-out PATH`` the audit
-  diff goes to ``PATH`` and the sharding diff to ``PATH.sharding``.
+  diff goes to ``PATH``, the sharding diff to ``PATH.sharding``, and
+  the thread-manifest diff to ``PATH.threads``.
 
 ``--json`` emits one document for every layer that ran::
 
@@ -48,13 +53,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m mercury_tpu.lint",
         description="graftlint: JAX-hazard AST linter (Layer 1) + "
                     "jaxpr/HLO structural auditor (Layer 2) + "
-                    "sharding & memory auditor (Layer 3)",
+                    "sharding & memory auditor (Layer 3) + "
+                    "host-concurrency auditor (Layer C)",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/directories for Layer 1 (default: the "
                          "mercury_tpu package)")
     ap.add_argument("--layer",
-                    choices=("ast", "metrics", "audit", "sharding", "all"),
+                    choices=("ast", "metrics", "audit", "sharding",
+                             "concurrency", "all"),
                     default="ast")
     ap.add_argument("--select", action="append", default=None,
                     metavar="RULE",
@@ -74,6 +81,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--shard-budgets", default=None, metavar="PATH",
                     help="Layer 3 shard_budgets.json to verify against "
                          "/ regenerate")
+    ap.add_argument("--thread-manifest", default=None, metavar="PATH",
+                    help="Layer C thread_manifest.json to verify "
+                         "against / regenerate")
     ap.add_argument("--regen", action="store_true",
                     help="re-measure and WRITE the budget file(s) instead "
                          "of verifying (review the diff before committing)")
@@ -134,6 +144,38 @@ def main(argv: Optional[List[str]] = None) -> int:
             if not errors:
                 print("graftlint metrics: emitted keys == registry == "
                       "docs glossary")
+        if errors:
+            rc = 1
+
+    if args.layer in ("concurrency", "all"):
+        from mercury_tpu.lint import concurrency
+
+        diff_out = args.diff_out
+        if diff_out and args.layer == "all":
+            diff_out = diff_out + ".threads"
+        try:
+            errors, warnings = concurrency.run_concurrency_check(
+                paths=args.paths or None,
+                manifest_path=args.thread_manifest,
+                regen=args.regen, diff_out=diff_out)
+        except FileNotFoundError as exc:
+            print(f"graftlint concurrency: thread manifest missing "
+                  f"({exc}) — run with --layer concurrency --regen "
+                  "first", file=sys.stderr)
+            return 2
+        except (OSError, ValueError) as exc:
+            print(f"graftlint concurrency: {exc}", file=sys.stderr)
+            return 2
+        if args.as_json:
+            collect("concurrency", errors, warnings)
+        else:
+            for line in warnings:
+                print(f"warning: {line}")
+            for line in errors:
+                print(line)
+            if not errors:
+                print("graftlint concurrency: thread fleet verified "
+                      "against lint/thread_manifest.json")
         if errors:
             rc = 1
 

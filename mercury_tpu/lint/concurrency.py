@@ -1,0 +1,971 @@
+"""graftlint Layer C: host-concurrency auditor (pure stdlib).
+
+The training process is not one thread — it is a small fleet:
+the prefetch worker (``data/stream.py``), the metric drain thread plus
+its observers (``obs/writer.py`` / ``obs/aggregate.py`` /
+``obs/anomaly.py``), the async checkpoint writer
+(``train/checkpoint.py``) and the scorer fleet
+(``sampling/scorer_fleet.py``). Layers 1–3 audit the *traced* program;
+this layer audits the Python threads that carry score freshness,
+telemetry and input streaming around it.
+
+Static model, built per class over :data:`HOT_THREAD_MODULES`:
+
+- **thread entry points** — functions handed to
+  ``threading.Thread(target=...)`` / ``executor.submit``, observer
+  callbacks (methods passed *by reference* into any call —
+  ``observers.append(self.agg.observe_record)``,
+  ``context_fn=self._flight_context``), and everything reachable from
+  them through ``self.method()`` calls. Every other method is assumed
+  to run on the constructing (trainer) thread; a function reachable
+  from both roots is treated as running on both sides.
+- **lock discipline** — ``self.X = threading.Lock()/RLock()/
+  Condition(...)`` declares a lock attribute
+  (``Condition(self._lock)`` aliases the underlying lock, so holding
+  the condition counts as holding the lock); an attribute's *guard* is
+  the lock held at its ``with self._lock:`` accesses.
+
+Rules (IDs registered in lint/rules.py so suppressions/--select resolve;
+the checks run only in this layer):
+
+- **GL120** — a cross-thread attribute (written on one side, accessed
+  on the other) has an inferred guard but some cross-thread access
+  does not hold it. Attributes with NO guard anywhere are flagged only
+  for cross-thread *write/write*: single-writer publish patterns
+  (whole-tuple ``_snap`` swap, ``_exc``, monotonic counters) are
+  CPython-atomic by design and are covered by the runtime harness
+  (lint/racecheck.py) instead of static guessing.
+- **GL121** — no-timeout ``put`` into a bounded queue, or one queue
+  mixing unbounded blocking ``get()`` with timeout gets.
+- **GL122** — non-daemon thread with no reachable ``join()``.
+- **GL123** — two locks acquired in opposite nesting orders (lexical
+  nesting plus one level of ``self.method()`` calls made while holding
+  a lock).
+- **GL124** — blocking call (``.join``, zero-arg ``.get()``,
+  ``time.sleep``) while lexically holding a lock.
+- **GL125** — thread / executor pool / queue not declared in the
+  committed ``lint/thread_manifest.json`` (``--regen`` / ``--diff-out``
+  parity, like the Layer 2/3 budget files): any new thread must be
+  declared and reviewed.
+
+Suppression uses the standard engine syntax with a mandatory reason::
+
+    if not self._profile_pending:  # graftlint: disable=GL120 -- lock-free fast path; stale read self-corrects next step
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from mercury_tpu.lint.engine import Finding, _parse_suppressions
+from mercury_tpu.lint.rules import RULES
+
+__all__ = [
+    "HOT_THREAD_MODULES",
+    "THREAD_MANIFEST_SCHEMA",
+    "default_manifest_path",
+    "extract_manifest",
+    "lint_concurrency_source",
+    "run_concurrency_check",
+]
+
+#: Version tag for ``thread_manifest.json``; bump on shape changes.
+THREAD_MANIFEST_SCHEMA = "graftlint_thread_manifest_v1"
+
+#: The hot host modules whose thread fleet this layer audits by default.
+HOT_THREAD_MODULES = (
+    "mercury_tpu/data/stream.py",
+    "mercury_tpu/obs/writer.py",
+    "mercury_tpu/obs/aggregate.py",
+    "mercury_tpu/obs/anomaly.py",
+    "mercury_tpu/sampling/scorer_fleet.py",
+    "mercury_tpu/train/checkpoint.py",
+    "mercury_tpu/train/trainer.py",
+)
+
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+_QUEUE_CTORS = frozenset({"Queue", "LifoQueue", "PriorityQueue"})
+#: Method calls on an attribute that mutate it in place (a write for the
+#: lock-discipline analysis).
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "update", "setdefault", "add", "discard",
+    "sort", "reverse",
+})
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_manifest_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "thread_manifest.json")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for nested Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``X`` when ``node`` is exactly ``self.X``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _name_literal(node: Optional[ast.AST]) -> Optional[str]:
+    """A thread-name expression as a manifest string: a plain literal
+    verbatim, an f-string as its constant prefix plus ``*``, anything
+    else (a variable) as None."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        prefix = ""
+        for part in node.values:
+            if isinstance(part, ast.Constant):
+                prefix += str(part.value)
+            else:
+                return prefix + "*"
+        return prefix
+    return None
+
+
+# ---------------------------------------------------------------- specs
+@dataclass
+class ThreadSpec:
+    module: str
+    cls: str
+    name: str           # literal, "prefix*", or "<dynamic>"
+    daemon: bool
+    line: int
+    target: Optional[str] = None
+    store: Optional[str] = None  # attr/var the Thread object landed in
+
+
+@dataclass
+class PoolSpec:
+    module: str
+    cls: str
+    prefix: str
+    line: int
+
+
+@dataclass
+class QueueSpec:
+    module: str
+    cls: str
+    attr: str
+    maxsize: Optional[str]  # unparse of the bound, None = unbounded
+    line: int
+
+
+@dataclass
+class _Access:
+    attr: str
+    write: bool
+    func: str
+    locks: frozenset
+    line: int
+    col: int
+
+
+@dataclass
+class _QueueOp:
+    attr: str
+    op: str            # put / put_nowait / get / get_nowait
+    bounded_wait: bool  # nowait or an explicit timeout
+    line: int
+    col: int
+
+
+@dataclass
+class ModuleModel:
+    """Everything Layer C extracted from one module."""
+
+    path: str
+    threads: List[ThreadSpec] = field(default_factory=list)
+    pools: List[PoolSpec] = field(default_factory=list)
+    queues: List[QueueSpec] = field(default_factory=list)
+    findings: List[Finding] = field(default_factory=list)
+
+
+def _mk_finding(rule_id: str, path: str, line: int, col: int,
+                message: str) -> Finding:
+    rule = RULES[rule_id]
+    return Finding(rule.id, rule.slug, path, line, col, message, rule.hint)
+
+
+# ------------------------------------------------- callback collection
+def collect_callback_names(tree: ast.Module) -> Set[str]:
+    """Attribute names referenced *by value* inside call arguments —
+    ``observers.append(self.agg.observe_record)`` marks
+    ``observe_record``; a method that is immediately CALLED is not a
+    callback. Over-approximate by design: a collected name only matters
+    when it matches a method of an analyzed class."""
+    call_funcs = {id(n.func) for n in ast.walk(tree)
+                  if isinstance(n, ast.Call)}
+    names: Set[str] = set()
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        operands = list(call.args) + [kw.value for kw in call.keywords]
+        for arg in operands:
+            for node in ast.walk(arg):
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)
+                        and id(node) not in call_funcs):
+                    names.add(node.attr)
+    return names
+
+
+# ------------------------------------------------------ class analysis
+class _ClassAnalyzer:
+    """Builds the per-class concurrency model and runs GL120–GL124."""
+
+    def __init__(self, cls: ast.ClassDef, path: str,
+                 callback_names: Set[str]) -> None:
+        self.cls = cls
+        self.path = path
+        self.callback_names = callback_names
+        self.lock_attrs: Set[str] = set()
+        self.cond_alias: Dict[str, str] = {}  # condition attr -> lock attr
+        self.queue_attrs: Dict[str, QueueSpec] = {}
+        self.threads: List[ThreadSpec] = []
+        self.pools: List[PoolSpec] = []
+        self.entry_roots: Set[str] = set()
+        self.methods: Dict[str, ast.AST] = {}
+        self.accesses: List[_Access] = []
+        self.queue_ops: List[_QueueOp] = []
+        self.calls: Dict[str, Set[str]] = {}          # func -> self-calls
+        self.acquired_by: Dict[str, Set[str]] = {}    # func -> locks used
+        self.lock_pairs: Dict[Tuple[str, str], int] = {}  # (outer, inner)
+        self.blocking: List[Tuple[str, int, int, str]] = []
+        self.joined: Set[str] = set()
+        self.for_alias: Dict[str, str] = {}
+        self.findings: List[Finding] = []
+
+    # ------------------------------------------------------- declarations
+    def _scan_declarations(self) -> None:
+        """Locks, condition aliases, queues, threads, pools, joins —
+        anywhere in the class body."""
+        thread_store: Dict[int, str] = {}
+        for node in ast.walk(self.cls):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target = node.target
+            if target is not None:
+                store = (_self_attr(target)
+                         or (target.id if isinstance(target, ast.Name)
+                             else None))
+                if store is not None:
+                    for sub in ast.walk(node.value):
+                        if (isinstance(sub, ast.Call)
+                                and self._ctor_kind(sub) == "thread"):
+                            thread_store[id(sub)] = store
+                attr = _self_attr(target)
+                if attr is not None and isinstance(node.value, ast.Call):
+                    self._classify_ctor(attr, node.value)
+            elif isinstance(node, ast.For):
+                tgt, it = node.target, _self_attr(node.iter)
+                if isinstance(tgt, ast.Name) and it is not None:
+                    self.for_alias[tgt.id] = it
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "join"):
+                recv = node.func.value
+                term = _self_attr(recv) or (
+                    recv.id if isinstance(recv, ast.Name) else None)
+                if term is not None:
+                    self.joined.add(term)
+        for node in ast.walk(self.cls):
+            if isinstance(node, ast.Call):
+                kind = self._ctor_kind(node)
+                if kind == "thread":
+                    self._record_thread(node, thread_store.get(id(node)))
+                elif kind == "pool":
+                    self._record_pool(node)
+
+    def _ctor_kind(self, call: ast.Call) -> Optional[str]:
+        name = _dotted(call.func)
+        if name is None:
+            return None
+        term = name.rsplit(".", 1)[-1]
+        if term == "Thread" and (name in ("Thread", "threading.Thread")):
+            return "thread"
+        if term == "ThreadPoolExecutor":
+            return "pool"
+        return None
+
+    def _classify_ctor(self, attr: str, call: ast.Call) -> None:
+        name = _dotted(call.func)
+        if name is None:
+            return
+        term = name.rsplit(".", 1)[-1]
+        if term in _LOCK_CTORS and name.split(".", 1)[0] in (
+                "threading", term):
+            self.lock_attrs.add(attr)
+            if term == "Condition" and call.args:
+                inner = _self_attr(call.args[0])
+                if inner is not None:
+                    self.cond_alias[attr] = inner
+        elif term in _QUEUE_CTORS and name.split(".", 1)[0] in (
+                "queue", term):
+            maxsize: Optional[ast.AST] = None
+            if call.args:
+                maxsize = call.args[0]
+            for kw in call.keywords:
+                if kw.arg == "maxsize":
+                    maxsize = kw.value
+            if (isinstance(maxsize, ast.Constant)
+                    and not maxsize.value):
+                maxsize = None  # Queue(0) is unbounded
+            self.queue_attrs[attr] = QueueSpec(
+                self.path, self.cls.name, attr,
+                None if maxsize is None else ast.unparse(maxsize),
+                call.lineno)
+
+    def _record_thread(self, call: ast.Call,
+                       store: Optional[str]) -> None:
+        kw = {k.arg: k.value for k in call.keywords}
+        target = kw.get("target")
+        target_name = None
+        if target is not None:
+            target_name = _self_attr(target) or (
+                target.id if isinstance(target, ast.Name) else None)
+        if target_name is not None:
+            self.entry_roots.add(target_name)
+        daemon = kw.get("daemon")
+        daemon_val = bool(daemon.value) if (
+            isinstance(daemon, ast.Constant)) else False
+        self.threads.append(ThreadSpec(
+            self.path, self.cls.name,
+            _name_literal(kw.get("name")) or "<dynamic>",
+            daemon_val, call.lineno, target=target_name, store=store))
+
+    def _record_pool(self, call: ast.Call) -> None:
+        for k in call.keywords:
+            if k.arg == "thread_name_prefix":
+                prefix = _name_literal(k.value)
+                if prefix:
+                    self.pools.append(PoolSpec(
+                        self.path, self.cls.name, prefix, call.lineno))
+        # submit targets become entry points too
+        # (handled in the per-function walk: executor.submit(self.m)).
+
+    # --------------------------------------------------- function walks
+    def _canon(self, lock: str) -> str:
+        return self.cond_alias.get(lock, lock)
+
+    def _walk_function(self, name: str, node: ast.AST) -> None:
+        self.methods[name] = node
+        self.calls.setdefault(name, set())
+        self.acquired_by.setdefault(name, set())
+
+        def lock_of(expr: ast.AST) -> Optional[str]:
+            attr = _self_attr(expr)
+            if attr is not None and (attr in self.lock_attrs):
+                return self._canon(attr)
+            return None
+
+        def record_access(attr: str, write: bool, locks: Tuple[str, ...],
+                          lineno: int, col: int) -> None:
+            if attr in self.lock_attrs or attr in self.queue_attrs:
+                return
+            if attr in self.methods or attr in (
+                    n.name for n in self.cls.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))):
+                return  # bound-method references are not state
+            self.accesses.append(_Access(
+                attr, write, name, frozenset(locks), lineno, col))
+
+        def visit(node: ast.AST, locks: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested def: its body runs later (possibly on another
+                # thread) — analyze as its own function, empty lock ctx.
+                self._walk_function(f"{name}.{node.name}", node)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held = list(locks)
+                for item in node.items:
+                    lk = lock_of(item.context_expr)
+                    visit(item.context_expr, tuple(held))
+                    if lk is not None:
+                        for outer in held:
+                            if outer != lk:
+                                self.lock_pairs.setdefault(
+                                    (outer, lk),
+                                    item.context_expr.lineno)
+                        held.append(lk)
+                        self.acquired_by[name].add(lk)
+                for stmt in node.body:
+                    visit(stmt, tuple(held))
+                return
+            if isinstance(node, ast.Call):
+                self._visit_call(node, locks, name, visit)
+                return
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is not None:
+                    record_access(
+                        attr, isinstance(node.ctx, (ast.Store, ast.Del)),
+                        locks, node.lineno, node.col_offset)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, locks)
+                return
+            if (isinstance(node, (ast.Subscript,))
+                    and isinstance(node.ctx, (ast.Store, ast.Del))):
+                # self._offsets[k] = v mutates _offsets
+                attr = _self_attr(node.value)
+                if attr is not None:
+                    record_access(attr, True, locks,
+                                  node.lineno, node.col_offset)
+            for child in ast.iter_child_nodes(node):
+                visit(child, locks)
+
+        body = node.body if hasattr(node, "body") else []
+        for stmt in body:
+            visit(stmt, ())
+
+    def _visit_call(self, node: ast.Call, locks: Tuple[str, ...],
+                    func_name: str, visit) -> None:
+        f = node.func
+        # self.method(...) — call-graph edge; while holding a lock it
+        # also contributes one-level lock-ordering pairs.
+        callee = _self_attr(f)
+        if callee is not None:
+            self.calls[func_name].add(callee)
+            if locks:
+                self.calls.setdefault(f"{func_name}", set())
+                self._held_calls = getattr(self, "_held_calls", [])
+                self._held_calls.append((callee, locks, node.lineno))
+        if isinstance(f, ast.Attribute):
+            recv_attr = _self_attr(f.value)
+            # executor.submit(self.m) / Thread(target=...) in expressions
+            if f.attr == "submit":
+                for arg in node.args[:1]:
+                    t = _self_attr(arg) or (
+                        arg.id if isinstance(arg, ast.Name) else None)
+                    if t is not None:
+                        self.entry_roots.add(t)
+            # queue discipline
+            if (recv_attr in self.queue_attrs
+                    and f.attr in ("put", "put_nowait",
+                                   "get", "get_nowait")):
+                has_timeout = any(kw.arg == "timeout"
+                                  for kw in node.keywords)
+                if f.attr in ("put", "get") and len(node.args) > (
+                        1 if f.attr == "put" else 0):
+                    # positional block/timeout args: treat as bounded
+                    has_timeout = True
+                self.queue_ops.append(_QueueOp(
+                    recv_attr, f.attr,
+                    f.attr.endswith("_nowait") or has_timeout,
+                    node.lineno, node.col_offset))
+            # in-place mutation of a shared attribute
+            if (recv_attr is not None and f.attr in _MUTATORS
+                    and recv_attr not in self.queue_attrs
+                    and recv_attr not in self.lock_attrs):
+                self.accesses.append(_Access(
+                    recv_attr, True, func_name, frozenset(locks),
+                    node.lineno, node.col_offset))
+            # blocking calls while holding a lock (GL124)
+            if locks:
+                self._check_blocking(node, f, func_name)
+        elif locks and _dotted(f) in ("time.sleep", "sleep"):
+            self.blocking.append(
+                (f"time.sleep while holding "
+                 f"{'/'.join(sorted(set(locks)))}",
+                 node.lineno, node.col_offset, func_name))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locks)
+
+    def _check_blocking(self, node: ast.Call, f: ast.Attribute,
+                        func_name: str) -> None:
+        recv_dotted = _dotted(f.value)
+        if f.attr == "join":
+            # os.path.join / "sep".join are string/path ops, not waits.
+            if isinstance(f.value, ast.Constant):
+                return
+            if recv_dotted is not None and (
+                    recv_dotted == "os.path"
+                    or recv_dotted.endswith(".path")):
+                return
+            self.blocking.append(
+                (f"blocking join() on "
+                 f"'{recv_dotted or ast.unparse(f.value)}' under a lock",
+                 node.lineno, node.col_offset, func_name))
+        elif (f.attr == "get" and not node.args
+                and not any(kw.arg == "timeout" for kw in node.keywords)
+                and not node.keywords):
+            # zero-arg .get() is a blocking queue get (dict.get needs
+            # a key).
+            self.blocking.append(
+                (f"unbounded blocking get() on "
+                 f"'{recv_dotted or ast.unparse(f.value)}' under a lock",
+                 node.lineno, node.col_offset, func_name))
+        elif (f.attr == "sleep" and recv_dotted is not None
+                and recv_dotted.startswith("time")):
+            self.blocking.append(
+                ("time.sleep under a lock",
+                 node.lineno, node.col_offset, func_name))
+
+    # ----------------------------------------------------------- closure
+    def _closure(self, roots: Set[str]) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = [r for r in roots if r in self.calls]
+        # nested functions are rooted by their qualified name too
+        frontier += [f for f in self.calls
+                     if f.split(".")[-1] in roots and f not in frontier]
+        while frontier:
+            f = frontier.pop()
+            if f in seen:
+                continue
+            seen.add(f)
+            for callee in self.calls.get(f, ()):
+                for cand in (callee,):
+                    if cand in self.calls and cand not in seen:
+                        frontier.append(cand)
+        return seen
+
+    # -------------------------------------------------------------- rules
+    def analyze(self) -> None:
+        self._scan_declarations()
+        for node in self.cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(node.name, node)
+
+        # one-level lock-ordering via calls made while holding a lock
+        for callee, locks, line in getattr(self, "_held_calls", []):
+            for inner in self.acquired_by.get(callee, ()):
+                for outer in locks:
+                    if outer != inner:
+                        self.lock_pairs.setdefault((outer, inner), line)
+
+        entry_roots = set(self.entry_roots)
+        entry_roots |= {m for m in self.methods
+                        if m.split(".")[-1] in self.callback_names
+                        and "." not in m}
+        reach_entry = self._closure(entry_roots)
+        other_roots = {m for m in self.methods
+                       if "." not in m and m not in reach_entry
+                       and m != "__init__"}
+        reach_other = self._closure(other_roots)
+
+        self._rule_gl120(reach_entry, reach_other)
+        self._rule_gl121()
+        self._rule_gl122()
+        self._rule_gl123()
+        self._rule_gl124()
+
+    def _sides(self, func: str, reach_entry: Set[str],
+               reach_other: Set[str]) -> Set[str]:
+        sides = set()
+        if func in reach_entry:
+            sides.add("entry")
+        if func in reach_other:
+            sides.add("other")
+        return sides
+
+    def _rule_gl120(self, reach_entry: Set[str],
+                    reach_other: Set[str]) -> None:
+        if not reach_entry:
+            return  # no thread entry points: nothing crosses threads
+        by_attr: Dict[str, List[Tuple[_Access, Set[str]]]] = {}
+        for a in self.accesses:
+            if a.func == "__init__":
+                continue  # init-before-start publish is safe
+            sides = self._sides(a.func, reach_entry, reach_other)
+            if not sides:
+                continue
+            by_attr.setdefault(a.attr, []).append((a, sides))
+        for attr, accs in sorted(by_attr.items()):
+            entry_w = any(a.write and "entry" in s for a, s in accs)
+            other_w = any(a.write and "other" in s for a, s in accs)
+            entry_any = any("entry" in s for a, s in accs)
+            other_any = any("other" in s for a, s in accs)
+            cross = (entry_w and other_any) or (other_w and entry_any)
+            if not cross:
+                continue
+            locked = [a for a, _ in accs if a.locks]
+            if not locked:
+                if entry_w and other_w:
+                    a = next(a for a, s in accs
+                             if a.write and "other" in s)
+                    self.findings.append(_mk_finding(
+                        "GL120", self.path, a.line, a.col,
+                        f"'{self.cls.name}.{attr}' is written from both "
+                        f"a thread entry point and the constructing "
+                        f"thread with no lock at all"))
+                continue
+            guards: Dict[str, int] = {}
+            for a in locked:
+                for lk in a.locks:
+                    guards[lk] = guards.get(lk, 0) + 1
+            guard = max(sorted(guards), key=lambda k: guards[k])
+            held = sum(1 for a, _ in accs if guard in a.locks)
+            reported: Set[int] = set()
+            for a, sides in accs:
+                if guard in a.locks or a.line in reported:
+                    continue
+                reported.add(a.line)
+                side = "thread-entry" if "entry" in sides else "trainer"
+                self.findings.append(_mk_finding(
+                    "GL120", self.path, a.line, a.col,
+                    f"'{self.cls.name}.{attr}' is shared across threads "
+                    f"but this {side}-side "
+                    f"{'write' if a.write else 'read'} does not hold "
+                    f"its guard '{guard}' (held at {held}/{len(accs)} "
+                    f"accesses)"))
+
+    def _rule_gl121(self) -> None:
+        ops_by_q: Dict[str, List[_QueueOp]] = {}
+        for op in self.queue_ops:
+            ops_by_q.setdefault(op.attr, []).append(op)
+        for attr, ops in sorted(ops_by_q.items()):
+            spec = self.queue_attrs[attr]
+            if spec.maxsize is not None:
+                for op in ops:
+                    if op.op == "put" and not op.bounded_wait:
+                        self.findings.append(_mk_finding(
+                            "GL121", self.path, op.line, op.col,
+                            f"no-timeout put() into bounded queue "
+                            f"'{self.cls.name}.{attr}' "
+                            f"(maxsize={spec.maxsize}): the producer "
+                            f"wedges forever once the consumer stops "
+                            f"draining"))
+            gets = [op for op in ops if op.op == "get"]
+            if (any(g.bounded_wait for g in gets)
+                    and any(not g.bounded_wait for g in gets)):
+                for g in gets:
+                    if not g.bounded_wait:
+                        self.findings.append(_mk_finding(
+                            "GL121", self.path, g.line, g.col,
+                            f"queue '{self.cls.name}.{attr}' mixes "
+                            f"unbounded blocking get() with timeout "
+                            f"gets — one consumer can hang forever "
+                            f"while the other is bounded"))
+
+    def _rule_gl122(self) -> None:
+        joined = {self.for_alias.get(n, n) for n in self.joined}
+        for t in self.threads:
+            if t.daemon:
+                continue
+            if t.store is None or t.store not in joined:
+                self.findings.append(_mk_finding(
+                    "GL122", self.path, t.line, 0,
+                    f"non-daemon thread '{t.name}' in {self.cls.name} "
+                    f"has no reachable join(): interpreter exit blocks "
+                    f"on it forever if the work wedges"))
+
+    def _rule_gl123(self) -> None:
+        for (a, b), line in sorted(self.lock_pairs.items()):
+            if (b, a) in self.lock_pairs and a < b:
+                other_line = self.lock_pairs[(b, a)]
+                self.findings.append(_mk_finding(
+                    "GL123", self.path, max(line, other_line), 0,
+                    f"locks '{a}' and '{b}' of {self.cls.name} are "
+                    f"acquired in both orders ({a}→{b} at line "
+                    f"{line}, {b}→{a} at line {other_line}): "
+                    f"deadlock ordering"))
+
+    def _rule_gl124(self) -> None:
+        for msg, line, col, func in self.blocking:
+            self.findings.append(_mk_finding(
+                "GL124", self.path, line, col,
+                f"{msg} (in {self.cls.name}.{func})"))
+
+
+# ----------------------------------------------------- module analysis
+def analyze_module(tree: ast.Module, path: str,
+                   callback_names: Set[str]) -> ModuleModel:
+    model = ModuleModel(path=path)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            an = _ClassAnalyzer(node, path, callback_names)
+            an.analyze()
+            model.findings.extend(an.findings)
+            model.threads.extend(an.threads)
+            model.pools.extend(an.pools)
+            model.queues.extend(an.queue_attrs.values())
+    _resolve_dynamic_names(tree, model)
+    model.findings.sort(key=lambda f: (f.line, f.col, f.rule_id))
+    return model
+
+
+def _resolve_dynamic_names(tree: ast.Module, model: ModuleModel) -> None:
+    """A Thread whose ``name=`` is a constructor parameter (the
+    ``_AsyncSave`` pattern) resolves through the class's call sites:
+    ``_AsyncSave(..., name=f"ckpt-write-{step}")`` names the thread."""
+    for spec in model.threads:
+        if spec.name != "<dynamic>":
+            continue
+        resolved: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _dotted(node.func)
+            if fname is None or fname.rsplit(".", 1)[-1] != spec.cls:
+                continue
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    lit = _name_literal(kw.value)
+                    if lit:
+                        resolved.add(lit)
+        if len(resolved) == 1:
+            spec.name = resolved.pop()
+
+
+# --------------------------------------------------- manifest handling
+def _manifest_doc(models: Sequence[ModuleModel]) -> Dict[str, Any]:
+    threads = sorted(
+        ({"module": t.module, "class": t.cls, "name": t.name,
+          "daemon": t.daemon} for m in models for t in m.threads),
+        key=lambda d: (d["module"], d["class"], d["name"]))
+    pools = sorted(
+        ({"module": p.module, "class": p.cls, "prefix": p.prefix}
+         for m in models for p in m.pools),
+        key=lambda d: (d["module"], d["class"], d["prefix"]))
+    queues = sorted(
+        ({"module": q.module, "class": q.cls, "attr": q.attr,
+          "maxsize": q.maxsize} for m in models for q in m.queues),
+        key=lambda d: (d["module"], d["class"], d["attr"]))
+    return {
+        "schema": THREAD_MANIFEST_SCHEMA,
+        "regenerate_with":
+            "python -m mercury_tpu.lint --layer concurrency --regen",
+        "threads": threads,
+        "pools": pools,
+        "queues": queues,
+    }
+
+
+def extract_manifest(paths: Sequence[str]) -> Dict[str, Any]:
+    """The thread manifest the given modules would declare today."""
+    models, _, errors = _analyze_paths(list(paths))
+    if errors:
+        raise ValueError("; ".join(errors))
+    return _manifest_doc(models)
+
+
+def _compare_manifest(models: Sequence[ModuleModel],
+                      manifest: Dict[str, Any],
+                      ) -> Tuple[List[Finding], List[str], List[str]]:
+    """(undeclared findings, stale warnings, diff lines)."""
+    findings: List[Finding] = []
+    warnings: List[str] = []
+    diff: List[str] = []
+
+    def key_of(d: Dict[str, Any], fields: Tuple[str, ...]) -> Tuple:
+        return tuple(d.get(f) for f in fields)
+
+    declared_threads = {key_of(d, ("module", "class", "name")): d
+                        for d in manifest.get("threads", ())}
+    declared_pools = {key_of(d, ("module", "class", "prefix"))
+                      for d in manifest.get("pools", ())}
+    declared_queues = {key_of(d, ("module", "class", "attr")): d
+                       for d in manifest.get("queues", ())}
+
+    seen_t, seen_p, seen_q = set(), set(), set()
+    for m in models:
+        for t in m.threads:
+            k = (t.module, t.cls, t.name)
+            seen_t.add(k)
+            d = declared_threads.get(k)
+            if d is None:
+                findings.append(_mk_finding(
+                    "GL125", t.module, t.line, 0,
+                    f"thread '{t.name}' (class {t.cls}, "
+                    f"daemon={t.daemon}) is not declared in the thread "
+                    f"manifest"))
+                diff.append(f"+ thread {t.module}:{t.cls} '{t.name}' "
+                            f"daemon={t.daemon}")
+            elif bool(d.get("daemon")) != t.daemon:
+                findings.append(_mk_finding(
+                    "GL125", t.module, t.line, 0,
+                    f"thread '{t.name}' (class {t.cls}) is declared "
+                    f"daemon={d.get('daemon')} but constructed "
+                    f"daemon={t.daemon}"))
+                diff.append(f"~ thread {t.module}:{t.cls} '{t.name}' "
+                            f"daemon {d.get('daemon')} -> {t.daemon}")
+        for p in m.pools:
+            k = (p.module, p.cls, p.prefix)
+            seen_p.add(k)
+            if k not in declared_pools:
+                findings.append(_mk_finding(
+                    "GL125", p.module, p.line, 0,
+                    f"executor pool '{p.prefix}' (class {p.cls}) is not "
+                    f"declared in the thread manifest"))
+                diff.append(f"+ pool {p.module}:{p.cls} '{p.prefix}'")
+        for q in m.queues:
+            k = (q.module, q.cls, q.attr)
+            seen_q.add(k)
+            d = declared_queues.get(k)
+            if d is None:
+                findings.append(_mk_finding(
+                    "GL125", q.module, q.line, 0,
+                    f"queue '{q.cls}.{q.attr}' "
+                    f"(maxsize={q.maxsize}) is not declared in the "
+                    f"thread manifest"))
+                diff.append(f"+ queue {q.module}:{q.cls}.{q.attr} "
+                            f"maxsize={q.maxsize}")
+            elif d.get("maxsize") != q.maxsize:
+                findings.append(_mk_finding(
+                    "GL125", q.module, q.line, 0,
+                    f"queue '{q.cls}.{q.attr}' capacity changed: "
+                    f"declared maxsize={d.get('maxsize')}, constructed "
+                    f"maxsize={q.maxsize}"))
+                diff.append(f"~ queue {q.module}:{q.cls}.{q.attr} "
+                            f"maxsize {d.get('maxsize')} -> {q.maxsize}")
+    for k in sorted(set(declared_threads) - seen_t):
+        warnings.append(f"thread manifest entry {k} no longer exists "
+                        "(stale — regenerate with --regen)")
+        diff.append(f"- thread {k[0]}:{k[1]} '{k[2]}'")
+    for k in sorted(declared_pools - seen_p):
+        warnings.append(f"pool manifest entry {k} no longer exists "
+                        "(stale — regenerate with --regen)")
+        diff.append(f"- pool {k[0]}:{k[1]} '{k[2]}'")
+    for k in sorted(set(declared_queues) - seen_q):
+        warnings.append(f"queue manifest entry {k} no longer exists "
+                        "(stale — regenerate with --regen)")
+        diff.append(f"- queue {k[0]}:{k[1]}.{k[2]}")
+    return findings, warnings, diff
+
+
+# ----------------------------------------------------------- entrypoints
+def lint_concurrency_source(source: str,
+                            path: str = "<string>") -> List[Finding]:
+    """Static GL120–GL124 over one module's source, suppressions
+    applied. The manifest check (GL125) needs the repo — see
+    :func:`run_concurrency_check`."""
+    tree = ast.parse(source)
+    callbacks = collect_callback_names(tree)
+    model = analyze_module(tree, path, callbacks)
+    return _apply_suppressions(model.findings, source)
+
+
+def _apply_suppressions(findings: Sequence[Finding],
+                        source: str) -> List[Finding]:
+    sup = _parse_suppressions(source)
+    kept = [f for f in findings
+            if f.rule_id not in sup.file_wide
+            and f.rule_id not in sup.per_line.get(f.line, ())]
+    return kept
+
+
+def _analyze_paths(files: List[str]) -> Tuple[
+        List[ModuleModel], Dict[str, str], List[str]]:
+    """Parse + analyze every file. Returns (models, sources by relpath,
+    hard errors). Module paths are repo-relative with forward slashes."""
+    root = _repo_root()
+    sources: Dict[str, str] = {}
+    trees: Dict[str, ast.Module] = {}
+    errors: List[str] = []
+    for f in files:
+        rel = os.path.relpath(os.path.abspath(f), root).replace(
+            os.sep, "/")
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            trees[rel] = ast.parse(src, filename=f)
+            sources[rel] = src
+        except (OSError, SyntaxError) as exc:
+            errors.append(f"{rel}: cannot analyze: {exc}")
+    callbacks: Set[str] = set()
+    for tree in trees.values():
+        callbacks |= collect_callback_names(tree)
+    models = [analyze_module(tree, rel, callbacks)
+              for rel, tree in sorted(trees.items())]
+    return models, sources, errors
+
+
+def run_concurrency_check(paths: Optional[Sequence[str]] = None,
+                          manifest_path: Optional[str] = None,
+                          regen: bool = False,
+                          diff_out: Optional[str] = None,
+                          ) -> Tuple[List[str], List[str]]:
+    """Layer C driver: static rules over the hot thread modules plus
+    thread-manifest parity. Returns ``(errors, warnings)`` — the Layer
+    2/3 contract; raises FileNotFoundError when the manifest is missing
+    and ``regen`` is false."""
+    root = _repo_root()
+    if paths is None:
+        files = [os.path.join(root, m) for m in HOT_THREAD_MODULES]
+    else:
+        files = []
+        for p in paths:
+            if os.path.isdir(p):
+                for dirpath, dirnames, filenames in os.walk(p):
+                    dirnames[:] = [d for d in dirnames
+                                   if d not in ("__pycache__", ".git")]
+                    files.extend(os.path.join(dirpath, f)
+                                 for f in sorted(filenames)
+                                 if f.endswith(".py"))
+            else:
+                files.append(p)
+    models, sources, errors = _analyze_paths(files)
+
+    manifest_path = manifest_path or default_manifest_path()
+    warnings: List[str] = []
+    per_module: Dict[str, List[Finding]] = {
+        m.path: list(m.findings) for m in models}
+
+    if regen:
+        doc = _manifest_doc(models)
+        with open(manifest_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        warnings.append(
+            f"thread manifest written to {manifest_path} "
+            f"({len(doc['threads'])} threads, {len(doc['pools'])} "
+            f"pools, {len(doc['queues'])} queues) — review the diff "
+            f"before committing")
+    else:
+        if not os.path.exists(manifest_path):
+            raise FileNotFoundError(manifest_path)
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        if manifest.get("schema") != THREAD_MANIFEST_SCHEMA:
+            errors.append(
+                f"{manifest_path}: schema "
+                f"{manifest.get('schema')!r}, expected "
+                f"{THREAD_MANIFEST_SCHEMA!r} — regenerate with --regen")
+            manifest = {"threads": [], "pools": [], "queues": []}
+        m_findings, m_warnings, diff = _compare_manifest(models, manifest)
+        warnings.extend(m_warnings)
+        for f in m_findings:
+            per_module.setdefault(f.path, []).append(f)
+        if diff and diff_out:
+            with open(diff_out, "w") as fh:
+                fh.write("\n".join(
+                    ["# graftlint thread-manifest diff"] + diff) + "\n")
+
+    all_findings: List[Finding] = []
+    for rel, findings in sorted(per_module.items()):
+        src = sources.get(rel)
+        kept = (_apply_suppressions(findings, src)
+                if src is not None else list(findings))
+        all_findings.extend(kept)
+    all_findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    errors.extend(f.format() for f in all_findings)
+    return errors, warnings

@@ -1,0 +1,370 @@
+"""TSan-lite: runtime race / thread-leak harness (pure stdlib).
+
+The static side of Layer C (lint/concurrency.py) proves lock discipline
+where it can and deliberately leaves the single-writer publish patterns
+(whole-tuple ``_snap`` swaps, monotonic counters, ``_closed`` flags) to
+runtime checking. This module is that runtime check: a stress test wraps
+live objects in a :class:`RaceMonitor`, hammers them from several
+threads, and the monitor reports every attribute that two threads
+touched (at least one write) without both holding an instrumented lock.
+
+It is *happens-before-free* by design — no vector clocks, just "was any
+watched lock held at the access" — so it over-reports code whose safety
+comes from ordering rather than locking. That is intentional: the
+harness runs on objects the caller nominates, and the caller declares
+which attributes are supposed to be lock-guarded.
+
+Usage::
+
+    from mercury_tpu.lint.racecheck import RaceMonitor, ThreadLeakGuard
+
+    mon = RaceMonitor()
+    mon.watch(writer, attrs=("errors", "dropped"), locks=("_lock",))
+    with mon:
+        ... hammer writer from threads ...
+    assert not mon.races()
+
+    guard = ThreadLeakGuard()          # snapshot live threads
+    ... run the suspect code ...
+    assert not guard.strays()          # non-daemon leftovers fail
+
+The conftest-wide leak fixture (tests/conftest.py) is built on
+:class:`ThreadLeakGuard`; opt a test out with the ``thread_leak_ok``
+marker when it legitimately parks daemon helpers (the slow distributed
+matrix).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "InstrumentedLock",
+    "InstrumentedQueue",
+    "RaceMonitor",
+    "RaceReport",
+    "ThreadLeakGuard",
+]
+
+# RaceMonitor state lives here, keyed by id(obj), NOT on the watched
+# object: the generated __getattribute__ override must never read an
+# attribute of the instance it instruments (infinite recursion).
+_MONITOR_STATE: Dict[int, "_WatchState"] = {}
+_STATE_LOCK = threading.Lock()
+
+# How many watched-object lock tokens the current thread holds. Using a
+# single count per thread (rather than per lock) deliberately treats a
+# Condition built on the object's lock as the same guard — matching the
+# Condition(self._lock) aliasing the static layer applies.
+_HELD = threading.local()
+
+
+def _held_count() -> int:
+    return getattr(_HELD, "count", 0)
+
+
+def _push_held() -> None:
+    _HELD.count = _held_count() + 1
+
+
+def _pop_held() -> None:
+    _HELD.count = max(0, _held_count() - 1)
+
+
+class InstrumentedLock:
+    """Proxy around a ``Lock`` / ``RLock`` / ``Condition`` that tracks
+    whether the current thread holds it. Delegates everything else
+    (``wait``/``notify``/…) to the wrapped object so Condition protocol
+    keeps working."""
+
+    def __init__(self, inner: Any) -> None:
+        self._inner = inner
+
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            _push_held()
+        return got
+
+    def release(self) -> None:
+        _pop_held()
+        self._inner.release()
+
+    def __enter__(self) -> "InstrumentedLock":
+        self._inner.__enter__()
+        _push_held()
+        return self
+
+    def __exit__(self, *exc: Any) -> Any:
+        _pop_held()
+        return self._inner.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        # Condition.wait releases the underlying lock for the wait —
+        # mirror that in the held count so accesses made by OTHER
+        # threads during our wait are not misattributed.
+        _pop_held()
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            _push_held()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+@dataclass
+class _AttrSide:
+    guarded_read: bool = False
+    naked_read: bool = False
+    guarded_write: bool = False
+    naked_write: bool = False
+    reads: int = 0
+    writes: int = 0
+
+
+@dataclass
+class _WatchState:
+    attrs: Tuple[str, ...]
+    # (attr, thread ident) -> what that thread did to the attr
+    sides: Dict[Tuple[str, int], _AttrSide] = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def record(self, attr: str, write: bool) -> None:
+        key = (attr, threading.get_ident())
+        guarded = _held_count() > 0
+        with self.lock:
+            side = self.sides.get(key)
+            if side is None:
+                side = self.sides[key] = _AttrSide()
+            if write:
+                side.writes += 1
+                if guarded:
+                    side.guarded_write = True
+                else:
+                    side.naked_write = True
+            else:
+                side.reads += 1
+                if guarded:
+                    side.guarded_read = True
+                else:
+                    side.naked_read = True
+
+
+@dataclass(frozen=True)
+class RaceReport:
+    """One attribute two threads raced on."""
+
+    obj: str
+    attr: str
+    threads: int
+    writes: int
+    reads: int
+
+    def __str__(self) -> str:
+        return (f"race on {self.obj}.{self.attr}: {self.threads} "
+                f"threads, {self.writes} writes / {self.reads} reads "
+                f"with at least one unsynchronized side")
+
+
+class InstrumentedQueue:
+    """queue.Queue stand-in recording op counts, for queue-discipline
+    stress assertions (puts that blocked, gets that timed out)."""
+
+    def __init__(self, inner: Any) -> None:
+        self._inner = inner
+        self._oplock = threading.Lock()
+        self.ops: Dict[str, int] = {
+            "put": 0, "put_nowait": 0, "get": 0, "get_nowait": 0,
+            "put_blocked": 0, "get_timeout": 0,
+        }
+
+    def _bump(self, op: str) -> None:
+        with self._oplock:
+            self.ops[op] += 1
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        self._bump("put")
+        if block and timeout is None and self._inner.full():
+            self._bump("put_blocked")
+        self._inner.put(item, block=block, timeout=timeout)
+
+    def put_nowait(self, item: Any) -> None:
+        self._bump("put_nowait")
+        self._inner.put_nowait(item)
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        self._bump("get")
+        try:
+            return self._inner.get(block=block, timeout=timeout)
+        except Exception:
+            if timeout is not None:
+                self._bump("get_timeout")
+            raise
+
+    def get_nowait(self) -> Any:
+        self._bump("get_nowait")
+        return self._inner.get_nowait()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+def _make_watched_class(base: type) -> type:
+    """A subclass of ``base`` whose attribute hooks report to the
+    id-keyed registry. Generated per base class; the instance is
+    restored to its original class when the monitor exits."""
+
+    def __getattribute__(self: Any, name: str) -> Any:
+        state = _MONITOR_STATE.get(id(self))
+        if state is not None and name in state.attrs:
+            state.record(name, write=False)
+        return base.__getattribute__(self, name)
+
+    def __setattr__(self: Any, name: str, value: Any) -> None:
+        state = _MONITOR_STATE.get(id(self))
+        if state is not None and name in state.attrs:
+            state.record(name, write=True)
+        base.__setattr__(self, name, value)
+
+    return type(f"_Watched_{base.__name__}", (base,), {
+        "__getattribute__": __getattribute__,
+        "__setattr__": __setattr__,
+    })
+
+
+class RaceMonitor:
+    """Watches nominated attributes of live objects for cross-thread
+    unsynchronized access. Context manager: instrumentation is applied
+    on ``__enter__`` and fully reverted on ``__exit__``."""
+
+    def __init__(self) -> None:
+        self._watched: List[Tuple[Any, Tuple[str, ...],
+                                  Tuple[str, ...]]] = []
+        self._applied: List[Tuple[Any, type, List[Tuple[str, Any]]]] = []
+        self._retained: Dict[int, _WatchState] = {}
+        self._active = False
+
+    def watch(self, obj: Any, attrs: Sequence[str],
+              locks: Sequence[str] = ()) -> "RaceMonitor":
+        """Register ``obj``: record accesses to ``attrs``; accesses made
+        while any of the ``locks`` attributes (Lock/RLock/Condition) is
+        held by the accessing thread count as guarded."""
+        if self._active:
+            raise RuntimeError("watch() before entering the monitor")
+        self._watched.append((obj, tuple(attrs), tuple(locks)))
+        return self
+
+    def __enter__(self) -> "RaceMonitor":
+        self._active = True
+        for obj, attrs, locks in self._watched:
+            original_cls = type(obj)
+            replaced: List[Tuple[str, Any]] = []
+            for lock_attr in locks:
+                inner = getattr(obj, lock_attr)
+                replaced.append((lock_attr, inner))
+                object.__setattr__(obj, lock_attr,
+                                   InstrumentedLock(inner))
+            with _STATE_LOCK:
+                _MONITOR_STATE[id(obj)] = _WatchState(attrs=attrs)
+            object.__setattr__(obj, "__class__",
+                               _make_watched_class(original_cls))
+            self._applied.append((obj, original_cls, replaced))
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        for obj, original_cls, replaced in self._applied:
+            object.__setattr__(obj, "__class__", original_cls)
+            for lock_attr, inner in replaced:
+                object.__setattr__(obj, lock_attr, inner)
+            with _STATE_LOCK:
+                state = _MONITOR_STATE.pop(id(obj), None)
+            if state is not None:
+                # keep the tallies queryable after exit — the common
+                # shape is assert-not-races() once the region ends
+                self._retained[id(obj)] = state
+        self._applied.clear()
+        self._active = False
+
+    def races(self) -> List[RaceReport]:
+        """Attributes with ≥2 threads, ≥1 write, and at least one side
+        unsynchronized (both-guarded access pairs are clean)."""
+        reports: List[RaceReport] = []
+        for obj, attrs, _locks in self._watched:
+            state = _MONITOR_STATE.get(id(obj)) or self._retained.get(
+                id(obj))
+            if state is None:
+                continue
+            by_attr: Dict[str, List[_AttrSide]] = {}
+            with state.lock:
+                for (attr, _tid), side in state.sides.items():
+                    by_attr.setdefault(attr, []).append(side)
+            for attr, sides in sorted(by_attr.items()):
+                if len(sides) < 2:
+                    continue
+                if not any(s.writes for s in sides):
+                    continue
+                # clean only when every participating side was always
+                # guarded for everything it did
+                naked = any(s.naked_read or s.naked_write
+                            for s in sides)
+                if not naked:
+                    continue
+                reports.append(RaceReport(
+                    obj=type(obj).__name__.replace("_Watched_", ""),
+                    attr=attr,
+                    threads=len(sides),
+                    writes=sum(s.writes for s in sides),
+                    reads=sum(s.reads for s in sides)))
+        return reports
+
+class ThreadLeakGuard:
+    """Snapshot the live threads now; later, report strays.
+
+    ``strays()`` grace-joins new non-daemon threads briefly (finishing
+    threads are not leaks) and returns whatever is still alive. Daemon
+    threads are reported separately via ``daemon_strays()`` — they
+    cannot wedge interpreter exit, but a test that silently leaves a
+    drain loop running is still polluting its neighbours.
+    """
+
+    def __init__(self, grace_s: float = 2.0) -> None:
+        self.grace_s = grace_s
+        self._baseline: Set[int] = {
+            t.ident for t in threading.enumerate() if t.ident is not None}
+
+    def _new_threads(self) -> List[threading.Thread]:
+        return [t for t in threading.enumerate()
+                if t.ident is not None and t.ident not in self._baseline
+                and t is not threading.current_thread()]
+
+    def strays(self) -> List[threading.Thread]:
+        """Non-daemon threads started after the snapshot and still
+        alive after a bounded grace join."""
+        fresh = [t for t in self._new_threads() if not t.daemon]
+        deadline_each = self.grace_s / max(1, len(fresh)) if fresh else 0
+        still: List[threading.Thread] = []
+        for t in fresh:
+            t.join(timeout=deadline_each)
+            if t.is_alive():
+                still.append(t)
+        return still
+
+    def daemon_strays(self) -> List[threading.Thread]:
+        """Daemon threads started after the snapshot and still alive
+        (no join — daemons may legitimately park in their run loop)."""
+        return [t for t in self._new_threads() if t.daemon and
+                t.is_alive()]
+
+    def check(self) -> None:
+        """Raise AssertionError naming any non-daemon stray."""
+        still = self.strays()
+        if still:
+            names = ", ".join(sorted(t.name for t in still))
+            raise AssertionError(
+                f"thread leak: non-daemon threads still alive after "
+                f"{self.grace_s:.1f}s grace: {names}")

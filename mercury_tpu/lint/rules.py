@@ -169,6 +169,63 @@ GL114 = _rule(
     "`# graftlint: disable=GL114 -- <why this thread may block>`",
 )
 
+# Layer C host-concurrency rules (lint/concurrency.py). Registered here
+# so suppressions and --select resolve their IDs/slugs, but deliberately
+# NOT in _CHECKS: Layer 1 never runs them — they need the cross-module
+# thread-entry / lock-discipline model only the concurrency layer builds.
+GL120 = _rule(
+    "GL120", "unguarded-shared-attr",
+    "shared mutable attribute crosses the thread boundary without its "
+    "guarding lock: written on one side (thread entry point or trainer "
+    "thread) and accessed on the other while the lock that guards its "
+    "other accesses is not held",
+    "hold the inferred guard around every cross-thread access (snapshot "
+    "under the lock, use the copy outside), or restructure to a "
+    "single-writer whole-object publish and suppress with the invariant "
+    "spelled out",
+)
+GL121 = _rule(
+    "GL121", "queue-discipline",
+    "inconsistent queue.Queue blocking discipline: a no-timeout put into "
+    "a BOUNDED queue (a shutdown wedge — the producer parks forever once "
+    "the consumer stops draining), or one queue mixing unbounded "
+    "blocking get() with timeout gets",
+    "bounded puts loop `put(item, timeout=...)` checking the shutdown "
+    "flag (the PrefetchPipeline._publish idiom); pick ONE get discipline "
+    "per queue",
+)
+GL122 = _rule(
+    "GL122", "unjoined-thread",
+    "non-daemon thread started with no reachable join(): interpreter "
+    "shutdown blocks forever on it if its work wedges",
+    "join it on the shutdown path (bounded timeout + log), or mark it "
+    "daemon=True when abandoning it at exit is safe",
+)
+GL123 = _rule(
+    "GL123", "lock-order",
+    "two locks acquired in opposite nesting orders on different code "
+    "paths of the same class: classic deadlock ordering once the paths "
+    "run on different threads",
+    "impose one global acquisition order (document it on the class) or "
+    "collapse the critical sections onto a single lock",
+)
+GL124 = _rule(
+    "GL124", "blocking-under-lock",
+    "blocking call (thread/queue join, unbounded queue get(), "
+    "time.sleep) while holding a lock: every thread touching that lock "
+    "stalls for the full wait",
+    "snapshot state under the lock and block after releasing it",
+)
+GL125 = _rule(
+    "GL125", "undeclared-thread",
+    "thread / executor pool / queue not declared in "
+    "lint/thread_manifest.json (or declared with a different daemon "
+    "flag / capacity): the process's concurrency surface changed "
+    "without review",
+    "run `python -m mercury_tpu.lint --layer concurrency --regen`, "
+    "review the manifest diff, and commit it",
+)
+
 # Mirror of parallel/mesh.py::MESH_AXES. Layer 1 must not import jax (or
 # anything that does), so the set is duplicated here; Layer 3's audit
 # cross-checks the two at every run (lint/sharding.py

@@ -180,7 +180,10 @@ class AnomalyEngine:
     def take_profile_request(self) -> int:
         """Steps of ``jax.profiler`` capture requested by the latest
         trigger; clears the request. Trainer-polled once per step."""
-        if not self._profile_pending:
+        # Lock-free fast path: a stale read costs at most one step of
+        # capture latency and self-corrects on the next poll; taking the
+        # lock every step would serialize the trainer against _trigger.
+        if not self._profile_pending:  # graftlint: disable=GL120 -- vetted lock-free fast path; stale read self-corrects next poll, the authoritative swap below holds the lock
             return 0
         with self._lock:
             n, self._profile_pending = self._profile_pending, 0
@@ -240,8 +243,10 @@ class AnomalyEngine:
                     detail[key] = record[key]
             self._trigger("straggler", step, detail)
 
-        if self.triggers:
-            record["anomaly/triggers"] = float(self.triggers)
+        with self._lock:
+            triggers = self.triggers
+        if triggers:
+            record["anomaly/triggers"] = float(triggers)
 
     # ----------------------------------------------------------- triggering
     def _trigger(self, kind: str, step: int,
@@ -277,14 +282,20 @@ class AnomalyEngine:
         if not self.dump_dir:
             return None
         try:
+            # Trigger tallies are written by _trigger on both the drain
+            # and trainer threads — snapshot them under the lock before
+            # the (slow, unlocked) serialization below.
+            with self._lock:
+                trigger_counts = dict(self.trigger_counts)
+                triggers_total = self.triggers
             doc: Dict[str, Any] = {
                 "schema": FLIGHT_RECORD_SCHEMA,
                 "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime()),
                 "trigger": {"kind": kind, "step": int(step),
                             "detail": detail or {}},
-                "trigger_counts": dict(self.trigger_counts),
-                "triggers_total": self.triggers,
+                "trigger_counts": trigger_counts,
+                "triggers_total": triggers_total,
                 "ring": list(self.ring),
                 "spans": (self.tracer.snapshot()
                           if self.tracer is not None else []),
@@ -306,7 +317,8 @@ class AnomalyEngine:
                 json.dump(doc, f, indent=2, default=str)
                 f.write("\n")
             os.replace(tmp, path)
-            self.dumps.append(path)
+            with self._lock:
+                self.dumps.append(path)
             return path
         except Exception as exc:
             _log.warning("flight-record dump failed: %s: %s",

@@ -85,6 +85,14 @@ METRIC_KEYS: Dict[str, str] = {
         "device-time share outside every named scope",
     "prof/h2d_overlap_frac": "H2D copy time hidden under device compute",
     "prof/idle_frac": "device-lane idle gaps over the capture span",
+    # threads/* — host thread-fleet liveness (obs/writer.py
+    # host_thread_stats + per-queue depths merged at the log gate);
+    # audited by graftlint Layer C against lint/thread_manifest.json
+    "threads/alive": "live python threads in this process",
+    "threads/daemon": "live daemon threads (the worker fleet)",
+    "threads/queue_depth/metrics": "async metric records pending drain",
+    "threads/queue_depth/prefetch": "committed prefetch batches pending",
+    "threads/queue_depth/scorer": "scored chunks pending application",
 }
 
 #: Bookkeeping fields that ride along in every record but are not metric

@@ -83,6 +83,9 @@ class AsyncMetricWriter:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.sinks = [s for s in sinks if s is not None]
+        # Copy-on-write: add_observer() swaps in a new list under _lock
+        # and _emit() snapshots it, so registration never races the
+        # drain thread mid-iteration.
         self.observers = [o for o in observers if o is not None]
         self.capacity = capacity
         self.dropped = 0
@@ -92,6 +95,7 @@ class AsyncMetricWriter:
         self._have_work = threading.Condition(self._lock)
         self._stop = False
         self._closed = False
+        self._busy = False
         self._autostart = start
         self._thread: Optional[threading.Thread] = None
 
@@ -121,6 +125,26 @@ class AsyncMetricWriter:
         """``MetricsLogger``-compatible alias for :meth:`write`."""
         self.write(step, scalars)
 
+    def add_observer(self, observer) -> bool:
+        """Register an observer after construction (copy-on-write, so
+        the drain thread's snapshot iteration never sees a list being
+        mutated). Returns False — and does NOT register — when the
+        writer is already closed: a late registration racing close()
+        would otherwise never see a record and mask a shutdown-order
+        bug."""
+        with self._lock:
+            if self._closed:
+                _log.warning("observer %r registered after close(); "
+                             "ignored", observer)
+                return False
+            self.observers = self.observers + [observer]
+            return True
+
+    def queue_depth(self) -> int:
+        """Records enqueued but not yet fanned out to the sinks."""
+        with self._lock:
+            return len(self._q) + (1 if self._busy else 0)
+
     def flush(self, timeout: float = 60.0) -> None:
         """Block until every record enqueued so far has been written to
         the sinks (and ask buffered sinks to hit the filesystem)."""
@@ -139,28 +163,34 @@ class AsyncMetricWriter:
                 try:
                     flush()
                 except Exception as exc:
-                    self.errors += 1
-                    _log.warning("sink %s flush failed: %s",
-                                 type(s).__name__, exc)
+                    self._note_error("sink %s flush failed: %s",
+                                     type(s).__name__, exc)
 
-    def close(self) -> None:
-        """Drain, stop the thread, close every sink. Idempotent."""
-        if self._closed:
-            return
-        self._closed = True
+    def close(self, timeout: float = 60.0) -> None:
+        """Drain, stop the thread, close every sink. Idempotent. Joins
+        the drain thread with a bounded ``timeout`` and logs — never
+        hangs on — a wedged thread (it is a daemon, so a wedged sink
+        cannot block interpreter exit either)."""
         with self._have_work:
+            if self._closed:
+                return
+            self._closed = True
             self._stop = True
             self._have_work.notify()
         if self._thread is not None:
-            self._thread.join(timeout=60.0)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                _log.warning(
+                    "metric drain thread %r still alive %.0fs after "
+                    "close() — abandoning it wedged (daemon)",
+                    self._thread.name, timeout)
         self._drain_pending()
         for s in self.sinks:
             try:
                 s.close()
             except Exception as exc:
-                self.errors += 1
-                _log.warning("sink %s close failed: %s",
-                             type(s).__name__, exc)
+                self._note_error("sink %s close failed: %s",
+                                 type(s).__name__, exc)
 
     def __enter__(self) -> "AsyncMetricWriter":
         return self
@@ -169,33 +199,42 @@ class AsyncMetricWriter:
         self.close()
 
     # ----------------------------------------------------------------- drain
-    _busy = False
+    def _note_error(self, msg: str, *log_args) -> None:
+        """Count + log a swallowed failure. Called from both the drain
+        thread and the trainer thread — the counter shares the writer's
+        lock so the tally never loses an increment."""
+        with self._lock:
+            self.errors += 1
+        _log.warning(msg, *log_args)
 
     def _emit(self, item) -> None:
         step, t, scalars = item
+        # Snapshot cross-thread state under the lock: `dropped` is
+        # incremented by the trainer in write(), `observers` is swapped
+        # by add_observer(); the copies are ours for the whole fan-out.
+        with self._lock:
+            dropped = self.dropped
+            observers = self.observers
         try:
             record = _to_host_record(step, t, scalars)
-            if self.dropped:
-                record["obs/dropped"] = float(self.dropped)
+            if dropped:
+                record["obs/dropped"] = float(dropped)
         except Exception as exc:
-            self.errors += 1
-            _log.warning("metric record for step %d failed on host "
-                         "conversion: %s", step, exc)
+            self._note_error("metric record for step %d failed on host "
+                             "conversion: %s", step, exc)
             return
-        for ob in self.observers:
+        for ob in observers:
             try:
                 ob(record)
             except Exception as exc:
-                self.errors += 1
-                _log.warning("observer %r failed at step %d: %s",
-                             ob, step, exc)
+                self._note_error("observer %r failed at step %d: %s",
+                                 ob, step, exc)
         for s in self.sinks:
             try:
                 s.write(record)
             except Exception as exc:
-                self.errors += 1
-                _log.warning("sink %s write failed at step %d: %s",
-                             type(s).__name__, step, exc)
+                self._note_error("sink %s write failed at step %d: %s",
+                                 type(s).__name__, step, exc)
 
     def _drain_pending(self) -> None:
         while True:
@@ -230,9 +269,23 @@ class AsyncMetricWriter:
                         try:
                             flush()
                         except Exception as exc:
-                            self.errors += 1
-                            _log.warning("sink %s idle-flush failed: %s",
-                                         type(s).__name__, exc)
+                            self._note_error(
+                                "sink %s idle-flush failed: %s",
+                                type(s).__name__, exc)
+
+
+def host_thread_stats() -> Dict[str, float]:
+    """Liveness census of the host thread fleet, cheap enough for every
+    log tick: ``threads/alive`` (every live Python thread in this
+    process, main included) and ``threads/daemon`` (the worker fleet —
+    prefetch, metric drain, scorers). A drift in either between ticks
+    is a thread leak or a silently-died worker; per-queue depths ride
+    along as ``threads/queue_depth/*`` from the emitters themselves."""
+    alive = threading.enumerate()
+    return {
+        "threads/alive": float(len(alive)),
+        "threads/daemon": float(sum(1 for t in alive if t.daemon)),
+    }
 
 
 # ------------------------------------------------------------------- sinks
@@ -307,7 +360,8 @@ class HeartbeatShardSink:
     stays one short line per log tick (on the drain thread)."""
 
     _KEYS = ("time/step", "data/stall_s", "data/queue_depth",
-             "obs/dropped", "anomaly/triggers", "host/straggler_ratio")
+             "obs/dropped", "anomaly/triggers", "host/straggler_ratio",
+             "threads/alive")
 
     def __init__(self, log_dir: str, process_index: int) -> None:
         os.makedirs(log_dir, exist_ok=True)

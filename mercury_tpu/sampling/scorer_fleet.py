@@ -325,13 +325,22 @@ class ScorerFleet:
         with self._lock:
             self._ages = []
 
-    def close(self) -> None:
-        """Idempotent shutdown: stop the workers and join them."""
+    def close(self, timeout: float = 30.0) -> None:
+        """Idempotent shutdown: stop the workers and join them with a
+        bounded wait — a wedged scorer (e.g. stuck in device compute)
+        is logged and abandoned (daemon), never hung on."""
         if self._closed:
             return
         self._closed = True
+        deadline = time.perf_counter() + timeout
         for t in self._threads:
-            t.join(timeout=30.0)
+            t.join(timeout=max(0.0, deadline - time.perf_counter()))
+        wedged = [t.name for t in self._threads if t.is_alive()]
+        if wedged:
+            _log.warning(
+                "scorer threads still alive %.0fs after close() — "
+                "abandoning wedged (daemon): %s",
+                timeout, ", ".join(wedged))
 
     # ----------------------------------------------------------- telemetry
     def stats(self) -> Dict[str, float]:
@@ -348,6 +357,7 @@ class ScorerFleet:
         return {
             "scorer/throughput": rows / dt,
             "sampler/refresh_lag_chunks": float(self._ready.qsize()),
+            "threads/queue_depth/scorer": float(self._ready.qsize()),
             "sampler/score_staleness_mean":
                 (sum(ages) / len(ages)) if ages else 0.0,
             "sampler/score_staleness_max": max(ages) if ages else 0.0,
@@ -356,7 +366,12 @@ class ScorerFleet:
     def summary(self) -> Dict[str, Any]:
         """Cumulative counters for flight records
         (``Trainer._flight_context``)."""
+        # _snap and _closed are single-writer published flags read
+        # lock-free everywhere (the workers poll them each iteration);
+        # reading them OUTSIDE the lock keeps the lint's guard inference
+        # honest — the lock below guards only the counters.
         snap = self._snap
+        closed = self._closed
         with self._lock:
             return {
                 "workers": self._workers,
@@ -367,5 +382,5 @@ class ScorerFleet:
                 "snapshots": self._snapshots,
                 "snapshot_step": None if snap is None else int(snap[2]),
                 "queue_depth": self._ready.qsize(),
-                "closed": self._closed,
+                "closed": closed,
             }

@@ -26,6 +26,10 @@ from typing import Any, Optional, Tuple
 
 import jax
 
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.train.checkpoint")
+
 
 def _orbax():
     try:
@@ -205,8 +209,22 @@ class _AsyncSave:
                                         daemon=False)
         self._thread.start()
 
-    def join(self) -> None:
-        self._thread.join()
+    def join(self, timeout: Optional[float] = 600.0) -> None:
+        """Wait for the write (default bound: 10 minutes — a full
+        msgpack serialize + fsync on a slow NFS mount, with headroom).
+        A writer still alive past the bound raises TimeoutError rather
+        than hanging shutdown forever on a wedged filesystem: the
+        thread is non-daemon, so the interpreter will still wait on it
+        at exit, but the caller gets a loud, attributable failure
+        instead of a silent hang here."""
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            _log.warning("checkpoint writer %r still running after "
+                         "%.0fs — wedged filesystem?",
+                         self._thread.name, timeout)
+            raise TimeoutError(
+                f"checkpoint write ({self._thread.name}) did not "
+                f"finish within {timeout:.0f}s")
         if self._exc is not None:
             raise self._exc
 

@@ -58,6 +58,7 @@ from mercury_tpu.obs.writer import (
     HeartbeatShardSink,
     HeartbeatSink,
     JsonlSink,
+    host_thread_stats,
     try_tensorboard_sink,
 )
 from mercury_tpu.parallel.mesh import make_mesh
@@ -954,6 +955,13 @@ class Trainer:
                             # Same contract: host counters only
                             # (scorer/throughput, staleness, lag).
                             record.update(self._scorer_fleet.stats())
+                        # Thread-fleet liveness (Layer C telemetry):
+                        # process-wide census + the metric queue's own
+                        # depth; the prefetch/scorer depths rode in with
+                        # their stats() above. Host-only, no sync.
+                        record.update(host_thread_stats())
+                        record["threads/queue_depth/metrics"] = float(
+                            self.logger.queue_depth())
                         record["epoch"] = (step - 1) // self.steps_per_epoch
                         if self._crosshost_gather is not None:
                             # allgather mode: EVERY process participates

@@ -32,7 +32,31 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+from mercury_tpu.lint.racecheck import ThreadLeakGuard  # noqa: E402
+
 
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leaks(request):
+    """Tier-1-wide thread-leak guard (graftlint Layer C's runtime side):
+    any test that starts a non-daemon thread must join it before
+    returning — a leaked writer/prefetch/checkpoint thread wedges the
+    whole pytest process at exit and poisons every later test's thread
+    census. Opt out with ``@pytest.mark.thread_leak_ok`` (the slow
+    distributed matrix parks helpers across tests by design)."""
+    if request.node.get_closest_marker("thread_leak_ok") is not None:
+        yield
+        return
+    guard = ThreadLeakGuard(grace_s=5.0)
+    yield
+    strays = guard.strays()
+    if strays:
+        names = ", ".join(sorted(t.name for t in strays))
+        pytest.fail(
+            f"test leaked non-daemon thread(s) still alive after the "
+            f"5s grace join: {names} — close()/join() them, or mark "
+            f"the test thread_leak_ok", pytrace=False)

@@ -148,6 +148,7 @@ class TestAsyncTrainer:
                 "sampler/refresh_lag_chunks",
                 "sampler/score_staleness_mean",
                 "sampler/score_staleness_max",
+                "threads/queue_depth/scorer",
             }
             assert all(np.isfinite(v) for v in stats.values())
         finally:

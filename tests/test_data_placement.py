@@ -139,7 +139,8 @@ class TestHostStream:
             assert np.all(np.isfinite(losses)), losses
             stats = hs._stream_pipe.stats()
             assert set(stats) == {"data/stall_s", "data/queue_depth",
-                                  "data/h2d_bytes"}
+                                  "data/h2d_bytes",
+                                  "threads/queue_depth/prefetch"}
             # 6 batches streamed: prime pushed 2, each step pushed 1 more.
             assert stats["data/h2d_bytes"] > 0
             assert hs._stream_pipe.pops == self.N_STEPS
