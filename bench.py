@@ -385,6 +385,28 @@ def _save_last_good(record: dict) -> None:
 #: import: the --stale-check-only path must stay stdlib-only (no jax).
 DEFAULT_MFU_FLOOR = 0.01
 DEFAULT_MAX_STALE_AGE_H = 72.0
+#: Ratchet: every fresh real-chip measurement raises the persisted floor
+#: (``mfu_floor`` in bench_last_good.json) to this fraction of its MFU,
+#: monotonically. The gate then judges against max(static floor,
+#: persisted floor), so an MFU win can't silently regress back to the
+#: static knob — the same monotone-ratchet idea as graftlint Layer 3's
+#: memory budgets.
+MFU_RATCHET_FRAC = 0.8
+
+
+def _ratchet_mfu_floor(record: dict, prior: dict | None) -> None:
+    """Persist the ratcheted floor into a fresh real-chip ``record``:
+    never below the static default, never below the prior record's
+    persisted floor, and raised to ``MFU_RATCHET_FRAC`` of this run's
+    measured MFU when that is higher still."""
+    floor = DEFAULT_MFU_FLOOR
+    prior_floor = (prior or {}).get("mfu_floor")
+    if isinstance(prior_floor, (int, float)):
+        floor = max(floor, float(prior_floor))
+    mfu = record.get("mfu")
+    if record.get("platform") == "tpu" and isinstance(mfu, (int, float)):
+        floor = max(floor, round(MFU_RATCHET_FRAC * float(mfu), 6))
+    record["mfu_floor"] = floor
 
 
 def slo_violations(record: dict | None,
@@ -396,8 +418,12 @@ def slo_violations(record: dict | None,
     Pure stdlib, pure function of the record — unit-testable and usable
     on the committed cache file without touching a backend. Checks, in
     order: hard failure, degraded (CPU) protocol, explicit stale mark,
-    timestamp age beyond ``max_age_h``, and a real-chip MFU below
-    ``mfu_floor`` (CPU records carry mfu=None/0.0 — never judged)."""
+    timestamp age beyond ``max_age_h``, and a real-chip MFU below the
+    floor (CPU records carry mfu=None/0.0 — never judged). The floor is
+    ``max(mfu_floor, record["mfu_floor"])``: a record carrying a
+    persisted (ratcheted) floor is judged against it, so ``--strict-stale``
+    enforces the best level past runs established, not just the static
+    knob."""
     out: list = []
     if not record:
         return ["no benchmark record (bench_last_good.json missing "
@@ -428,9 +454,14 @@ def slo_violations(record: dict | None,
                    f"(max_stale_age_h={max_age_h:g}) — no fresh "
                    "real-chip measurement")
     mfu = record.get("mfu")
-    if (record.get("platform") == "tpu" and mfu_floor > 0
-            and mfu is not None and mfu < mfu_floor):
-        out.append(f"mfu {mfu:g} below SLO floor {mfu_floor:g}")
+    floor = mfu_floor
+    ratcheted = record.get("mfu_floor")
+    if isinstance(ratcheted, (int, float)) and ratcheted > floor:
+        floor = float(ratcheted)
+    if (record.get("platform") == "tpu" and floor > 0
+            and mfu is not None and mfu < floor):
+        tag = " (ratcheted)" if floor > mfu_floor else ""
+        out.append(f"mfu {mfu:g} below SLO floor {floor:g}{tag}")
     return out
 
 
@@ -578,6 +609,10 @@ def main():
                   file=sys.stderr)
 
     if record is not None and record.get("platform") == "tpu":
+        # Fresh real-chip result: ratchet the persisted MFU floor before
+        # committing, so the saved record carries the level the next
+        # --strict-stale run must clear.
+        _ratchet_mfu_floor(record, _load_last_good())
         _save_last_good(record)
 
     if record is None:

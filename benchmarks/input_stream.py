@@ -24,6 +24,10 @@ CPU-runnable (8 virtual devices, the test-harness platform)::
 
     python benchmarks/input_stream.py [--smoke]
 
+``--fused`` swaps the comparison: fused_input=True vs False, both
+host_stream (same RNG chain → same trajectory), checking the fused
+uint8 ingest (``ops.augment_normalize_pallas``) never costs steps/s.
+
 Appends one JSON record to ``results_input_stream.jsonl``.
 """
 
@@ -48,7 +52,7 @@ import _bootstrap  # noqa: F401,E402
 import numpy as np  # noqa: E402
 
 
-def build(placement: str, args):
+def build(placement: str, args, fused: bool = False):
     from mercury_tpu.config import TrainConfig
     from mercury_tpu.parallel.mesh import make_mesh
     from mercury_tpu.train.trainer import Trainer
@@ -61,6 +65,7 @@ def build(placement: str, args):
         presample_batches=3,
         sampler=args.sampler,
         data_placement=placement,
+        fused_input=fused,
         prefetch_depth=args.depth,
         decode_workers=args.decode_workers,
         num_epochs=1,
@@ -153,6 +158,65 @@ class StreamArm:
         return total / self.timed_steps if self.timed_steps else 0.0
 
 
+def run_fused(args) -> int:
+    """``--fused``: fused_input=True vs False, both host_stream.
+
+    Same interleaved-block protocol as the main comparison, but both arms
+    stream — the variable under test is the ingest path (``ops.
+    augment_normalize_pallas`` vs the unfused normalize→augment HLO
+    chain). The two arms replay the same RNG chain, so they train the
+    same trajectory; the check is that fusing the ingest never *costs*
+    throughput (on TPU it additionally shrinks the H2D slab to uint8
+    end-to-end and the CPU fallback lowers to the identical gather
+    chain, so parity is the floor, not the target).
+    """
+    import jax
+
+    fused = StreamArm(build("host_stream", args, fused=True))
+    unfused = StreamArm(build("host_stream", args))
+    for _ in range(args.rounds):
+        fused.run_block(args.calls)
+        unfused.run_block(args.calls)
+
+    speedup_pct = 100.0 * (fused.steps_per_s / unfused.steps_per_s - 1.0)
+    record = {
+        "schema": "input_stream_fused_v1",
+        "model": args.model,
+        "sampler": args.sampler,
+        "world_size": args.world,
+        "batch_size": args.batch,
+        "prefetch_depth": args.depth,
+        "decode_workers": args.decode_workers,
+        "calls": args.calls,
+        "rounds": args.rounds,
+        "smoke": bool(args.smoke),
+        "fused": True,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "fused_steps_per_s": round(fused.steps_per_s, 3),
+        "unfused_steps_per_s": round(unfused.steps_per_s, 3),
+        "fused_speedup_pct": round(speedup_pct, 2),
+        "fused_stall_fraction": round(fused.stall_fraction, 4),
+        "unfused_stall_fraction": round(unfused.stall_fraction, 4),
+        "fused_h2d_bytes_per_step": int(fused.h2d_bytes_per_step),
+        "unfused_h2d_bytes_per_step": int(unfused.h2d_bytes_per_step),
+        "fused_block_rates": [round(r, 3) for r in fused.rates],
+        "unfused_block_rates": [round(r, 3) for r in unfused.rates],
+    }
+    fused.trainer.close()
+    unfused.trainer.close()
+    with open(args.out, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record, indent=2))
+    if speedup_pct < -5.0:
+        print(f"# WARNING: fused ingest {speedup_pct:+.1f}% vs unfused — "
+              "the fused path should never cost throughput (CPU timing is "
+              "noisy; rerun with more --calls before reading much into it)",
+              file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="smallcnn")
@@ -168,6 +232,9 @@ def main(argv=None) -> int:
                     help="interleaved block pairs; medians reported")
     ap.add_argument("--smoke", action="store_true",
                     help="CI-sized run: world 4, batch 32, 3 rounds")
+    ap.add_argument("--fused", action="store_true",
+                    help="compare fused_input=True vs False host_stream "
+                         "arms instead of host_stream vs replicated")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "results_input_stream.jsonl"))
     args = ap.parse_args(argv)
@@ -175,6 +242,9 @@ def main(argv=None) -> int:
         args.world, args.batch, args.calls, args.rounds = 4, 32, 10, 3
 
     import jax
+
+    if args.fused:
+        return run_fused(args)
 
     stream = StreamArm(build("host_stream", args))
     repl = ReplicatedArm(build("replicated", args))

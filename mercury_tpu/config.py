@@ -364,6 +364,16 @@ class TrainConfig:
     # None → auto (Pallas kernels on TPU, jax-native elsewhere);
     # True/False force. Pallas path requires label_smoothing == 0.
     use_pallas: Optional[bool] = None
+    # Fused uint8 ingest: replace the normalize_images + augment_batch HLO
+    # chain with ops.augment_normalize_pallas — dequant → per-channel
+    # normalize → crop/flip in one VMEM pass (raw bytes enter device
+    # memory as uint8, 4× less HBM traffic), under the mercury_input_fuse
+    # named scope. Bit-identical trajectories to the unfused path at f32
+    # (test-enforced); with scoring_dtype="bfloat16" the scorer-only
+    # ingest emits bf16 directly (uint8 → bf16 scoring, no f32 round
+    # trip). Runs in interpret mode on CPU. Requires uint8 image data,
+    # augmentation="noniid", cutout=False.
+    fused_input: bool = False
 
     # Dispatch --------------------------------------------------------------
     # Train steps fused into ONE device dispatch via lax.scan. The reference

@@ -17,8 +17,11 @@ torchvision's ``RandomAffine``.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from mercury_tpu.data.pipeline import hflip_batch, random_crop_to_batch
 
@@ -27,6 +30,18 @@ def resize_batch(images: jax.Array, size: int) -> jax.Array:
     """Bilinear resize to ``size×size`` (``transforms.Resize``)."""
     n, _, _, c = images.shape
     return jax.image.resize(images, (n, size, size, c), method="bilinear")
+
+
+@functools.lru_cache(maxsize=None)
+def _centered_grid(h: int, w: int):
+    """Host-side center-relative f32 meshgrid for ``affine_batch``, cached
+    per (h, w): rebuilding it with ``jnp`` on every call re-emitted an
+    iota+broadcast chain into each retrace. As numpy constants they embed
+    once per compiled program and cost nothing across retraces."""
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    return ys - cy, xs - cx
 
 
 def affine_batch(
@@ -49,9 +64,8 @@ def affine_batch(
     )
     scale = jax.random.uniform(k2, (n,), minval=scale_min, maxval=scale_max)
     cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
-    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
-                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
-    yc, xc = (ys - cy)[None], (xs - cx)[None]            # [1, h, w]
+    yc_np, xc_np = _centered_grid(h, w)
+    yc, xc = yc_np[None], xc_np[None]                    # [1, h, w]
     # Inverse map: rotate by -θ, scale by 1/s.
     cos_t = jnp.cos(theta)[:, None, None]
     sin_t = jnp.sin(theta)[:, None, None]

@@ -50,7 +50,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 SCHEMA = "graftlint_budgets_v1"
-PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "sp", "pp", "async")
+PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "hs_fused", "sp", "pp",
+              "async")
 
 # The seed step's metric surface — what telemetry=False must reproduce
 # exactly (mirrors benchmarks/telemetry_overhead.py::BASE_KEYS).
@@ -193,7 +194,13 @@ def measure_step(step_fn, args: Tuple, plan: str,
     from mercury_tpu.compat import donate_argnums
 
     m = PlanMeasurement(plan=plan, config=config)
-    m.expected_donated_args = len(donate_argnums(0))
+    # host_stream plans donate the streamed slab (arg 1) on top of the
+    # state (arg 0) — mirror make_train_step's donate_argnums call so the
+    # consistency check below audits what the step actually configures.
+    if config.get("data_placement") == "host_stream":
+        m.expected_donated_args = len(donate_argnums(0, 1))
+    else:
+        m.expected_donated_args = len(donate_argnums(0))
 
     closed = jax.make_jaxpr(step_fn)(*args)
     for scope in SCOPES:
@@ -354,6 +361,50 @@ def _build_hs():
     return trainer.train_step, args, dict(kw, plan="hs")
 
 
+def _build_hs_fused():
+    """host_stream with the fused uint8 ingest AND end-to-end bf16
+    scoring: ``augment_normalize_pallas`` replaces the normalize+augment
+    HLO chain (interpret-mode on the CPU audit — same jaxpr structure as
+    the Mosaic lowering) and the scoring forward runs bf16 from uint8 to
+    score. Gets its OWN plan entry so the fused program carries its own
+    ``scoring_ops`` budget and donation-consistency check — the streamed
+    slab must stay donated when the kernel consumes it."""
+    import jax
+
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    kw: Dict[str, Any] = dict(
+        model="smallcnn",
+        dataset="synthetic",
+        world_size=2,
+        batch_size=8,
+        presample_batches=2,
+        sampler="pool",
+        data_placement="host_stream",
+        prefetch_depth=2,
+        fused_input=True,
+        scoring_dtype="bfloat16",
+        num_epochs=1,
+        steps_per_epoch=100,
+        eval_every=0,
+        log_every=0,
+        scan_steps=1,
+        compute_dtype="float32",
+        telemetry=False,
+        heartbeat_every=0,
+        seed=0,
+    )
+    config = TrainConfig(**kw)
+    trainer = Trainer(config, mesh=make_mesh(2, config.mesh_axis))
+    staging = trainer._stream_pipe._staging[0]
+    x_t = jax.ShapeDtypeStruct(staging.shape, staging.dtype)
+    args = (trainer.state, x_t, trainer._step_y,
+            trainer.dataset.shard_indices)
+    return trainer.train_step, args, dict(kw, plan="hs_fused")
+
+
 def _build_sp():
     """2 data × 2 seq mesh, ring-attention transformer — the
     TestDpSpMercuryStep construction, scaled down."""
@@ -426,6 +477,7 @@ _BUILDERS = {
     "zero": lambda: _build_fused("zero"),
     "dp_bf16": lambda: _build_fused("dp_bf16"),
     "hs": _build_hs,
+    "hs_fused": _build_hs_fused,
     "sp": _build_sp,
     "pp": _build_pp,
     "async": _build_async,
@@ -454,9 +506,10 @@ def check_invariants(m: PlanMeasurement) -> List[str]:
             f"{sorted(m.metric_keys)} != seed surface "
             f"{sorted(SEED_METRIC_KEYS)} — the compile-away guarantee "
             "is broken")
-    if m.plan == "dp_bf16" and m.f32_scoring_dots != 0:
+    if m.config.get("scoring_dtype") == "bfloat16" \
+            and m.f32_scoring_dots != 0:
         errors.append(
-            f"plan dp_bf16: {m.f32_scoring_dots} f32×f32 dot/conv op(s) "
+            f"plan {m.plan}: {m.f32_scoring_dots} f32×f32 dot/conv op(s) "
             "inside the mercury_scoring scope with "
             "scoring_dtype=bfloat16 (expected 0: a silent upcast erases "
             "the scoring FLOP savings)")
@@ -480,6 +533,19 @@ def check_invariants(m: PlanMeasurement) -> List[str]:
             f"plan {m.plan}: {m.donation_markers} donation marker(s) in "
             "the lowered program but compat.donate_argnums configures "
             "none on this jax version")
+    if m.donation_markers >= 0 \
+            and m.donation_markers < m.expected_donated_args:
+        # Donation consistency, the other direction: every configured
+        # donated argument must leave at least one aliasing/buffer-donor
+        # marker in the lowered program. For host_stream plans this is
+        # the "streamed slab actually donated" assertion — a non-donated
+        # PendingSelection output silently pinning the slab would show
+        # up here as a missing marker.
+        errors.append(
+            f"plan {m.plan}: only {m.donation_markers} donation "
+            f"marker(s) in the lowered program for "
+            f"{m.expected_donated_args} donated argument(s) — a donated "
+            "input (state or streamed slab) is not actually aliased")
     return errors
 
 

@@ -50,6 +50,7 @@ SCOPES: Tuple[str, ...] = (
     "mercury_scoring",
     "mercury_grad_sync",
     "mercury_augmentation",
+    "mercury_input_fuse",
     "mercury_optimizer",
 )
 
@@ -64,6 +65,7 @@ _SCOPE_METRIC_KEYS: Dict[str, str] = {
     "mercury_scoring": "prof/scope_frac/mercury_scoring",
     "mercury_grad_sync": "prof/scope_frac/mercury_grad_sync",
     "mercury_augmentation": "prof/scope_frac/mercury_augmentation",
+    "mercury_input_fuse": "prof/scope_frac/mercury_input_fuse",
     "mercury_optimizer": "prof/scope_frac/mercury_optimizer",
     UNATTRIBUTED: "prof/scope_frac/unattributed",
 }

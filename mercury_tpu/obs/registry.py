@@ -80,6 +80,8 @@ METRIC_KEYS: Dict[str, str] = {
     "prof/scope_frac/mercury_grad_sync": "device-time share: grad sync",
     "prof/scope_frac/mercury_augmentation":
         "device-time share: augmentation scope",
+    "prof/scope_frac/mercury_input_fuse":
+        "device-time share: fused uint8 ingest kernel",
     "prof/scope_frac/mercury_optimizer": "device-time share: optimizer",
     "prof/scope_frac/unattributed":
         "device-time share outside every named scope",

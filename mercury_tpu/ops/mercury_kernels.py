@@ -1,7 +1,8 @@
 """Pallas TPU kernels for the Mercury hot ops.
 
-Two kernels cover the importance-sampling inner loop (the math of
-``Trainer.update_samples``, ``pytorch_collab.py:101-117``):
+Four kernels cover the importance-sampling inner loop (the math of
+``Trainer.update_samples``, ``pytorch_collab.py:101-117``) plus the
+uint8 ingest path that feeds it:
 
 1. :func:`per_sample_nll_pallas` — fused per-sample cross-entropy
    (log-softmax + label gather in one VMEM pass, ≡ ``F.cross_entropy(...,
@@ -12,6 +13,18 @@ Two kernels cover the importance-sampling inner loop (the math of
    inverse-CDF categorical draws → ``p·N`` gather (≡ ``:111-116``), one
    VMEM-resident kernel: the cumulative distribution never round-trips to
    HBM.
+3. :func:`table_refresh_draw_pallas` — fused scoretable step: age-decay +
+   refresh-window scatter + smoothing + inverse-CDF draw over the whole
+   persistent ``[L]`` table in one VMEM pass.
+4. :func:`augment_normalize_pallas` — fused uint8 ingest: dequant →
+   per-channel normalize → random crop(pad)/hflip in one VMEM pass per
+   image (``_data_transforms_cifar10``, ``cifar10/data_loader.py:83-96``).
+   The raw bytes enter VMEM as uint8 (4× less HBM traffic than the f32
+   HLO chain it replaces) and the crop/flip are gather-free one-hot
+   selections, bit-identical to ``normalize_images`` + ``augment_batch``.
+   Off-TPU its wrapper dispatches to an equivalent jax-native fused chain
+   instead of the interpreter (the one-hot matmuls are MXU work;
+   ``use_kernel=True`` forces the kernel for interpret-mode parity tests).
 
 Uniform variates are passed in (from ``jax.random``) rather than drawn with
 the in-kernel TPU PRNG, so the draw is reproducible from a JAX key and the
@@ -408,3 +421,131 @@ def table_refresh_draw_pallas(
         uniforms,
     )
     return new_table[:n, 0], probs[:n, 0], selected[0, :], scaled[0, :]
+
+
+# ----------------------------------------------------------------- kernel 4
+def _augment_norm_kernel(
+    raw_ref, mean_ref, std_ref, oy_ref, ox_ref, flip_ref, out_ref,
+    *, pad: int, out_dtype,
+):
+    """Fused dequant → normalize → crop/flip for ONE image (grid over the
+    batch): the raw uint8 block is read once, everything else stays in
+    VMEM.
+
+    ``raw_ref``: [1, H, W, C] uint8; ``mean_ref``/``std_ref``: [1, C] f32
+    per-channel constants; ``oy_ref``/``ox_ref``/``flip_ref``: [1, 1] SMEM
+    int32 — this image's crop offsets (0..2·pad) and flip bit.
+
+    Bit-exactness contract (vs ``normalize_images`` + ``augment_batch``):
+    normalize is elementwise so it commutes exactly with the crop/flip
+    gathers, and the unfused path pads AFTER normalizing — out-of-bounds
+    pixels are literal 0.0 in normalized space, which the one-hot
+    selection reproduces for free (no source row/col matches → the
+    mask-and-reduce sums to zero). The crop and the flip fold into one
+    column selection: ``src_x = (W-1-x if flip else x) + ox - pad``
+    (crop-then-flip ≡ flipped-column crop). One-hot × value sums are
+    IEEE-exact — each output pixel is one picked value plus signed zeros.
+    """
+    x = raw_ref[0].astype(jnp.float32) / 255.0            # [H, W, C]
+    xn = (x - mean_ref[0][None, None, :]) / std_ref[0][None, None, :]
+    h, w, _ = xn.shape
+    oy = oy_ref[0, 0]
+    ox = ox_ref[0, 0]
+    flip = flip_ref[0, 0]
+
+    # Row select: out1[y] = padded[y + oy] = xn[y + oy - pad] (0.0 OOB).
+    src_y = jax.lax.broadcasted_iota(jnp.int32, (h, h), 1) + oy - pad
+    rsel = (jax.lax.broadcasted_iota(jnp.int32, (h, h), 0) == src_y
+            ).astype(jnp.float32)                         # [Y_src, y_out]
+    out1 = jnp.sum(rsel[:, :, None, None] * xn[:, None, :, :], axis=0)
+
+    # Column select with the flip folded in (see docstring).
+    x_out = jax.lax.broadcasted_iota(jnp.int32, (w, w), 1)
+    x_eff = jnp.where(flip != 0, w - 1 - x_out, x_out)
+    csel = (jax.lax.broadcasted_iota(jnp.int32, (w, w), 0) == x_eff + ox - pad
+            ).astype(jnp.float32)                         # [X_src, x_out]
+    out = jnp.sum(csel[None, :, :, None] * out1[:, :, None, :], axis=1)
+    # + 0.0 canonicalizes the all-(-0.0) OOB corner to the unfused path's
+    # +0.0 pad value; every other pixel is unchanged (exact for v != 0).
+    out_ref[0] = (out + 0.0).astype(out_dtype)
+
+
+def augment_normalize_pallas(
+    key: jax.Array,
+    raw: jax.Array,
+    mean,
+    std,
+    pad: int = 4,
+    out_dtype=jnp.float32,
+    use_kernel=None,
+) -> jax.Array:
+    """Fused uint8 ingest: dequant + per-channel normalize + random
+    crop(``pad``) + horizontal flip in one VMEM pass, bit-identical (at
+    f32) to ``augment_batch(key, normalize_images(raw, mean, std))``.
+
+    ``raw``: [N, H, W, C] uint8; ``mean``/``std``: per-channel constants.
+    ``out_dtype`` is applied as the LAST op on either path, so the bf16
+    scoring path (``scoring_dtype="bfloat16"`` + ``fused_input``) emits
+    bf16 activations directly — one rounding of the exact f32 value, never
+    an f32 round trip through HBM.
+
+    ``use_kernel=None`` picks the Mosaic kernel on real TPU (the one-hot
+    selections there are MXU work and the uint8 block enters VMEM once);
+    elsewhere it falls to a jax-native fused chain built from the exact
+    unfused ops (``normalize_images`` → pad → ``_take_crops`` → flip) with
+    the pre-drawn offsets, because the one-hot matmuls that are cheap on
+    the MXU are ~H× extra FLOPs for the CPU interpreter. Tests pass
+    ``use_kernel=True`` to pin the interpret-mode kernel's bit-parity.
+
+    The crop/flip draws replay ``augment_batch``'s key consumption exactly
+    (split 3 ways; ``randint`` for offsets, ``bernoulli`` for flips), so a
+    trajectory is reproducible from the same JAX key on either path. Runs
+    under the ``mercury_input_fuse`` named scope — the profile-attribution
+    bucket (``prof/scope_frac/mercury_input_fuse``) and the jaxpr auditor
+    both key on this anchor."""
+    n, h, w, c = raw.shape
+    # Mirror augment_batch's split even though cutout is unsupported here
+    # (config validation rejects fused_input + cutout): the draw STREAM
+    # must match so unfused trajectories replay bit-for-bit.
+    k_crop, k_flip, _k_cut = jax.random.split(key, 3)
+    off = jax.random.randint(k_crop, (n, 2), 0, 2 * pad + 1)
+    flip = jax.random.bernoulli(k_flip, shape=(n,))
+    if use_kernel is None:
+        use_kernel = on_tpu()
+    if not use_kernel:
+        from mercury_tpu.data.pipeline import _take_crops, normalize_images
+
+        with jax.named_scope("mercury_input_fuse"):
+            xn = normalize_images(raw, mean, std)
+            padded = jnp.pad(xn, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+            out = _take_crops(padded, off[:, 0], off[:, 1], h, w)
+            out = jnp.where(flip[:, None, None, None],
+                            out[:, :, ::-1, :], out)
+            return out.astype(jnp.dtype(out_dtype))
+    kernel = functools.partial(
+        _augment_norm_kernel, pad=pad, out_dtype=jnp.dtype(out_dtype),
+    )
+    smem = pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM)
+    chan = pl.BlockSpec((1, c), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    with jax.named_scope("mercury_input_fuse"):
+        return pl.pallas_call(
+            kernel,
+            grid=(n,),
+            out_shape=jax.ShapeDtypeStruct((n, h, w, c), jnp.dtype(out_dtype)),
+            in_specs=[
+                pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0),
+                             memory_space=pltpu.VMEM),
+                chan, chan,
+                smem, smem, smem,
+            ],
+            out_specs=pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            interpret=_interpret(),
+        )(
+            raw,
+            jnp.asarray(mean, jnp.float32).reshape(1, c),
+            jnp.asarray(std, jnp.float32).reshape(1, c),
+            off[:, 0:1].astype(jnp.int32),
+            off[:, 1:2].astype(jnp.int32),
+            flip[:, None].astype(jnp.int32),
+        )

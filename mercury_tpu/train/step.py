@@ -367,6 +367,23 @@ def make_train_step(
                 "host_stream requires a data-only mesh (no tensor/fsdp "
                 "axis); drop tensor_parallel/fsdp_parallel"
             )
+    fused_input = bool(config.fused_input)
+    if fused_input:
+        if config.augmentation != "noniid":
+            raise ValueError(
+                "fused_input fuses the noniid crop/flip augmentation into "
+                "the ingest kernel (ops.augment_normalize_pallas); set "
+                f"augmentation='noniid' (got {config.augmentation!r})"
+            )
+        if config.cutout:
+            raise ValueError(
+                "fused_input does not fuse cutout; set cutout=False"
+            )
+    # scoring_dtype="bfloat16" end-to-end: scorer-only ingest sites (rows
+    # whose images are never reused for training) emit bf16 directly —
+    # with fused_input the kernel's final cast, so the scoring forward is
+    # bf16 from uint8 to score with no f32 activation round trip.
+    scoring_bf16 = config.scoring_dtype == "bfloat16"
     # Streamed rows per worker per step: the candidate pool for the pool
     # sampler (selection happens in-step on the streamed rows), the
     # refresh window + the pre-drawn train batch for the scoretable one —
@@ -446,6 +463,33 @@ def make_train_step(
             raise ValueError(f"unknown augmentation {config.augmentation!r}")
         return images
 
+    def _ingest(key, raw, out_dtype=None):
+        """Raw rows → augmented normalized images: THE ingest boundary —
+        every sampler path funnels its pixel rows through here. Unfused,
+        it is the ``normalize_images`` + ``_augment`` HLO chain; with
+        ``config.fused_input`` it is one Pallas VMEM pass
+        (``ops.augment_normalize_pallas``, ``mercury_input_fuse`` scope)
+        that consumes ``key`` identically, so trajectories are
+        bit-identical at f32 (test-enforced, tests/test_ops.py).
+        ``out_dtype`` (the bf16 scoring ingest) is applied as the LAST op
+        on both paths, so the fused/unfused agreement survives the cast."""
+        if fused_input:
+            if raw.dtype != jnp.uint8:
+                raise ValueError(
+                    "fused_input ingests raw uint8 rows (the kernel owns "
+                    f"the /255 dequant); got {raw.dtype}"
+                )
+            from mercury_tpu.ops import augment_normalize_pallas
+
+            return augment_normalize_pallas(
+                key, raw, mean, std,
+                out_dtype=(jnp.float32 if out_dtype is None else out_dtype),
+            )
+        imgs = _augment(key, normalize_images(raw, mean, std))
+        if out_dtype is not None:
+            imgs = imgs.astype(out_dtype)
+        return imgs
+
     def _select(k_sel, pool_losses, ema):
         """EMA update + score→normalize→draw, returning
         ``(selected, scaled_probs, new_ema, avg_pool_loss)`` — shared by the
@@ -466,15 +510,22 @@ def make_train_step(
         )
         return sel.selected, sel.scaled_probs, sel.ema, sel.avg_pool_loss
 
-    def score_rows(state, raw, labs, ka):
+    def score_rows(state, raw, labs, ka, reuse_images=True):
         """Augment → inference-mode scoring forward over already-gathered
         rows — the pool-scoring core shared by the device-resident
         ``score_slots`` prologue and the host-stream body (whose rows
         arrive pre-gathered from the host pipeline). Callers wrap the
         call in the ``mercury_scoring`` named scope the jaxpr auditor
         anchors on (one scope per call site — nesting would rename the
-        anchor). Returns ``(imgs, pool_logits, scores)``."""
-        imgs = _augment(ka, normalize_images(raw, mean, std))
+        anchor). ``reuse_images=False`` marks scorer-only sites (the
+        returned images are discarded, e.g. scoretable refresh windows):
+        with ``scoring_dtype="bfloat16"`` those ingest straight to bf16 —
+        uint8 → bf16 score, no f32 activation round trip. Returns
+        ``(imgs, pool_logits, scores)``."""
+        scorer_only = not reuse_images and scoring_bf16
+        imgs = _ingest(
+            ka, raw, out_dtype=jnp.bfloat16 if scorer_only else None
+        )
         if scoring_model is None:
             pool_logits, _, _ = _apply_train(
                 state.params, state.batch_stats, imgs, False
@@ -482,14 +533,19 @@ def make_train_step(
         else:
             # Same params, lower-precision compute (scoring_dtype) —
             # scores only rank candidates, and the reweight divides by
-            # the realized probs, so this stays unbiased.
+            # the realized probs, so this stays unbiased. The forward's
+            # input is pre-cast to the scoring dtype (a no-op when the
+            # ingest already emitted bf16) so the activations never
+            # materialize at f32; the returned imgs keep the training
+            # precision when the caller reuses them.
+            s_in = imgs.astype(jnp.bfloat16) if scoring_bf16 else imgs
             variables = {"params": state.params}
             mutable = ["losses"]
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
                 mutable = ["batch_stats", "losses"]
             pool_logits, _ = scoring_model.apply(
-                variables, imgs, train=True, mutable=mutable
+                variables, s_in, train=True, mutable=mutable
             )
             pool_logits = pool_logits.astype(jnp.float32)
         return imgs, pool_logits, _score_per_sample(pool_logits, labs)
@@ -702,17 +758,21 @@ def make_train_step(
             clip_frac = jnp.zeros((), jnp.float32)
             drift = jnp.zeros((), jnp.float32)
 
-        def score_slots(slots, ka):
+        def score_slots(slots, ka, reuse_images=True):
             """Gather → augment → inference-mode scoring forward — the
             pool-scoring prologue shared by the inline, pipelined,
             cadence, and groupwise IS paths (one definition so a change
             to scoring cannot drift between them). The whole prologue
             runs under the ``mercury_scoring`` named scope — the jaxpr
             auditor (``mercury_tpu/lint/audit.py``) keys per-region
-            checks (e.g. bf16-scoring dot dtypes) on this anchor."""
+            checks (e.g. bf16-scoring dot dtypes) on this anchor.
+            ``reuse_images`` forwards to ``score_rows`` (False at
+            scorer-only sites: bf16 ingest under scoring_dtype)."""
             with jax.named_scope("mercury_scoring"):
                 raw, labs = gather_train(slots)
-                imgs, pool_logits, scores = score_rows(state, raw, labs, ka)
+                imgs, pool_logits, scores = score_rows(
+                    state, raw, labs, ka, reuse_images=reuse_images
+                )
                 return imgs, labs, pool_logits, scores
 
         if pipelined:
@@ -790,7 +850,7 @@ def make_train_step(
                 stream, ema, _, _ = args
                 stream, slots = next_pool(stream, k_stream, pool_size)
                 _, labs, pool_logits, pool_losses = score_slots(
-                    slots, k_aug
+                    slots, k_aug, reuse_images=False
                 )
                 avg = pool_mean(pool_losses, stat_axis)
                 ema_prev = ema.value
@@ -823,7 +883,7 @@ def make_train_step(
             selected = draw_with_replacement(k_sel, cached.probs, batch_size)
             scaled_probs = cached.probs[selected] * pool_size
             sel_raw, sel_labels = gather_train(cached.slots[selected])
-            sel_images = _augment(k_aug2, normalize_images(sel_raw, mean, std))
+            sel_images = _ingest(k_aug2, sel_raw)
             avg_pool_loss = cached.pool_loss
             new_cached = cached
         elif use_scoretable:
@@ -888,7 +948,7 @@ def make_train_step(
             else:
                 refresh_slots = refresh_window(table, refresh_size)
                 _, r_labels, r_logits, r_scores = score_slots(
-                    refresh_slots, k_aug
+                    refresh_slots, k_aug, reuse_images=False
                 )
                 score_avg = pool_mean(r_scores, stat_axis)
                 ema_prev = ema.value
@@ -915,9 +975,7 @@ def make_train_step(
                     r_logits, r_labels, score_avg
                 )
             sel_raw, sel_labels = gather_train(selected)
-            sel_images = _augment(
-                k_aug2, normalize_images(sel_raw, mean, std)
-            )
+            sel_images = _ingest(k_aug2, sel_raw)
             table_scores_predraw = new_scores
             table_selected = selected
             if telemetry:
@@ -957,8 +1015,10 @@ def make_train_step(
                 # --- importance scoring: ONE batched inference forward over
                 # the pool (≡ the 10-iteration no_grad loop, :95-106),
                 # batch-stat normalization, running-stat updates discarded --
+                # Groupwise discards the scored images (drawn slots are
+                # re-gathered below), so its scoring pass is scorer-only.
                 images, labels, pool_logits, pool_losses = score_slots(
-                    slots, k_aug
+                    slots, k_aug, reuse_images=not use_groupwise
                 )
                 if use_groupwise:
                     # Persist scores into the shard-wide importance array,
@@ -969,9 +1029,7 @@ def make_train_step(
                     groupwise = update_importance(groupwise, slots, pool_losses)
                     sel_slots, scaled_probs = gw_draw(groupwise, k_sel, batch_size)
                     sel_raw, sel_labels = gather_train(sel_slots)
-                    sel_images = _augment(
-                        k_aug2, normalize_images(sel_raw, mean, std)
-                    )
+                    sel_images = _ingest(k_aug2, sel_raw)
                     score_avg = pool_mean(pool_losses, stat_axis)
                     ema_prev = ema.value
                     ema = ema_update(ema, score_avg, config.ema_alpha)
@@ -1000,9 +1058,7 @@ def make_train_step(
                 # IS weights so loss/(N·p) = loss. (pool_size == batch_size
                 # here, so no scoring forward and no wasted gather.)
                 raw, sel_labels = gather_train(slots)
-                sel_images = _augment(
-                    k_aug, normalize_images(raw, mean, std)
-                )[:batch_size]
+                sel_images = _ingest(k_aug, raw)[:batch_size]
                 sel_labels = sel_labels[:batch_size]
                 scaled_probs = jnp.ones((batch_size,), jnp.float32)
                 avg_pool_loss = jnp.zeros((), jnp.float32)
@@ -1139,9 +1195,7 @@ def make_train_step(
                     config.table_decay,
                 )
                 sel_labels = y_train[shard_indices[0][train_slots]]
-                sel_images = _augment(
-                    k_aug2, normalize_images(xs, mean, std)
-                )
+                sel_images = _ingest(k_aug2, xs)
                 scaled_probs = psel.scaled_probs[0]
                 avg_pool_loss = jnp.zeros((), jnp.float32)
             else:
@@ -1153,7 +1207,8 @@ def make_train_step(
                 with jax.named_scope("mercury_scoring"):
                     r_labels = y_train[shard_indices[0][refresh_slots]]
                     _, r_logits, r_scores = score_rows(
-                        state, xs[:refresh_size], r_labels, k_aug
+                        state, xs[:refresh_size], r_labels, k_aug,
+                        reuse_images=False,
                     )
                 score_avg = pool_mean(r_scores, stat_axis)
                 ema_prev = ema.value
@@ -1169,9 +1224,7 @@ def make_train_step(
                     refresh_slots, r_scores,
                 )
                 sel_labels = y_train[shard_indices[0][train_slots]]
-                sel_images = _augment(
-                    k_aug2, normalize_images(xs[refresh_size:], mean, std)
-                )
+                sel_images = _ingest(k_aug2, xs[refresh_size:])
                 scaled_probs = psel.scaled_probs[0]
                 avg_pool_loss = _pool_loss_metric(
                     r_logits, r_labels, score_avg
@@ -1207,9 +1260,7 @@ def make_train_step(
             # Uniform baseline (pool_size == batch_size): consume the
             # streamed rows directly, unit IS weights.
             sel_labels = y_train[shard_indices[0][front]][:batch_size]
-            sel_images = _augment(
-                k_aug, normalize_images(xs, mean, std)
-            )[:batch_size]
+            sel_images = _ingest(k_aug, xs)[:batch_size]
             scaled_probs = jnp.ones((batch_size,), jnp.float32)
             avg_pool_loss = jnp.zeros((), jnp.float32)
 
@@ -1422,7 +1473,18 @@ def make_train_step(
     jit_kw = {}
     if state_out_shardings is not None:
         jit_kw["out_shardings"] = state_out_shardings
-    return jax.jit(sharded, donate_argnums=donate_argnums(0), **jit_kw)
+    # host_stream also donates the streamed slab (arg 1): the rows are
+    # consumed by this step only (trainer pops, dispatches, drops — see
+    # Trainer._host_stream_step), and without the donation the slab stays
+    # live across the whole step, blocking the H2D-for-t+1 / compute
+    # overlap the lookahead exists to buy. The non-donated next_gidx
+    # output never aliases it (int32 [W, S] vs uint8 rows), so the
+    # PendingSelection outputs no longer pin the buffer. Layer-3's
+    # memory_analysis() ratchet + the Layer-2 donation-consistency check
+    # (lint/audit.py) pin this down per plan. donate_argnums is the
+    # compat shim: () on legacy jax (persistent-cache aliasing bug).
+    donated = donate_argnums(0, 1) if host_stream else donate_argnums(0)
+    return jax.jit(sharded, donate_argnums=donated, **jit_kw)
 
 
 def make_host_stream_prime(config: TrainConfig, mesh: Mesh):

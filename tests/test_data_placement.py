@@ -178,6 +178,61 @@ class TestHostStream:
             hs.close()
 
 
+class TestFusedInput:
+    """fused_input=True: the ``ops.augment_normalize_pallas`` ingest must
+    replay the unfused normalize→augment trajectory BIT-identically — the
+    kernel replays ``augment_batch``'s exact RNG consumption, so fusing is
+    a pure lowering change, never a numerics change. Tier-1 pins the
+    1-device stream paths; the world-4 matrix entry lives in
+    ``TestHostStreamMatrix`` (slow)."""
+
+    N_STEPS = 6
+
+    def test_uniform_stream_fused_matches_replicated_unfused(self, mesh1):
+        rep = Trainer(hs_cfg(use_importance_sampling=False), mesh=mesh1)
+        hs = Trainer(hs_cfg(data_placement="host_stream", prefetch_depth=2,
+                            fused_input=True,
+                            use_importance_sampling=False), mesh=mesh1)
+        try:
+            np.testing.assert_array_equal(
+                steps(rep, self.N_STEPS), stream_steps(hs, self.N_STEPS))
+        finally:
+            hs.close()
+
+    def test_pool_stream_fused_matches_replicated_unfused(self, mesh1):
+        rep = Trainer(hs_cfg(), mesh=mesh1)
+        hs = Trainer(hs_cfg(data_placement="host_stream", prefetch_depth=2,
+                            fused_input=True), mesh=mesh1)
+        try:
+            np.testing.assert_array_equal(
+                steps(rep, self.N_STEPS), stream_steps(hs, self.N_STEPS))
+        finally:
+            hs.close()
+
+    def test_scoretable_stream_fused_matches_unfused(self, mesh1):
+        """Streamed scoretable is depth-stale vs replicated by design, so
+        the invariant here is fused-stream == unfused-stream."""
+        a = Trainer(hs_cfg(data_placement="host_stream", prefetch_depth=2,
+                           sampler="scoretable"), mesh=mesh1)
+        b = Trainer(hs_cfg(data_placement="host_stream", prefetch_depth=2,
+                           sampler="scoretable", fused_input=True),
+                    mesh=mesh1)
+        try:
+            np.testing.assert_array_equal(
+                stream_steps(a, self.N_STEPS), stream_steps(b, self.N_STEPS))
+        finally:
+            a.close()
+            b.close()
+
+    @pytest.mark.parametrize("bad", [
+        dict(cutout=True),
+        dict(augmentation="iid"),
+    ])
+    def test_unfusable_configs_rejected(self, mesh1, bad):
+        with pytest.raises(ValueError, match="fused_input"):
+            Trainer(hs_cfg(fused_input=True, **bad), mesh=mesh1)
+
+
 class TestHostStreamMatrix:
     """4-way parallelism matrix — compile cost belongs in the slow tier."""
 
@@ -191,6 +246,20 @@ class TestHostStreamMatrix:
         rep = Trainer(cfg(steps_per_epoch=8, **kw), mesh=mesh)
         hs = Trainer(cfg(data_placement="host_stream", prefetch_depth=2,
                          steps_per_epoch=8, **kw), mesh=mesh)
+        try:
+            np.testing.assert_array_equal(steps(rep, 6), stream_steps(hs, 6))
+        finally:
+            hs.close()
+
+    @pytest.mark.parametrize("kw", [
+        dict(use_importance_sampling=False),
+        dict(),  # pool
+    ])
+    def test_w4_fused_bitwise_identical(self, mesh, kw):
+        rep = Trainer(cfg(steps_per_epoch=8, **kw), mesh=mesh)
+        hs = Trainer(cfg(data_placement="host_stream", prefetch_depth=2,
+                         fused_input=True, steps_per_epoch=8, **kw),
+                     mesh=mesh)
         try:
             np.testing.assert_array_equal(steps(rep, 6), stream_steps(hs, 6))
         finally:

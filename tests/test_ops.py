@@ -1,13 +1,21 @@
 """Pallas kernel tests (interpret mode on CPU): fused per-sample CE must
 match the jax-native version bit-for-bit-ish, its VJP must match autodiff,
-and the fused score/draw must match the importance pipeline distributionally."""
+the fused score/draw must match the importance pipeline distributionally,
+and the fused uint8 ingest must match the unfused normalize→augment chain
+bit-for-bit at f32 on both its paths (native fallback and the
+interpret-mode Mosaic kernel)."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from mercury_tpu.ops import per_sample_nll_pallas, score_and_draw_pallas
+from mercury_tpu.data.pipeline import augment_batch, normalize_images
+from mercury_tpu.ops import (
+    augment_normalize_pallas,
+    per_sample_nll_pallas,
+    score_and_draw_pallas,
+)
 from mercury_tpu.sampling.importance import importance_probs, per_sample_loss
 
 
@@ -180,3 +188,73 @@ class TestChunkedDrawLargePools:
         # Top-decile mass comparison (per-bin noise at 8k draws is large).
         top = np.argsort(p)[-pool // 10:]
         np.testing.assert_allclose(freq[top].sum(), p[top].sum(), atol=0.03)
+
+
+_MEAN = np.asarray([0.4914, 0.4822, 0.4465], np.float32)
+_STD = np.asarray([0.2470, 0.2435, 0.2616], np.float32)
+
+
+@pytest.fixture(scope="module")
+def raw_uint8():
+    rng = np.random.default_rng(3)
+    return jnp.asarray(rng.integers(0, 256, (8, 32, 32, 3), dtype=np.uint8))
+
+
+def _unfused_ingest(key, raw, out_dtype=None):
+    out = augment_batch(key, normalize_images(raw, _MEAN, _STD))
+    return out if out_dtype is None else out.astype(out_dtype)
+
+
+class TestAugmentNormalize:
+    """Fused uint8 ingest vs the unfused normalize→augment chain. Both
+    sides are JITTED in every comparison: XLA rewrites the /255 and /std
+    divisions (reciprocal-multiply) in compiled programs only, so
+    eager-vs-jit differs in the last ulp while jit-vs-jit is bit-exact —
+    and jit-vs-jit is the comparison the train step actually makes."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 7])
+    def test_native_path_bit_identical_f32(self, raw_uint8, seed):
+        key = jax.random.key(seed)
+        fused = jax.jit(
+            lambda k, r: augment_normalize_pallas(k, r, _MEAN, _STD)
+        )(key, raw_uint8)
+        ref = jax.jit(_unfused_ingest)(key, raw_uint8)
+        assert fused.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_interpret_kernel_bit_identical_f32(self, raw_uint8, seed):
+        """use_kernel=True pins the Mosaic kernel itself (interpret mode
+        on CPU): one-hot row/col selection with the flip folded into the
+        column select must reproduce the gather chain exactly, including
+        the all-zero out-of-bounds border from the crop padding."""
+        key = jax.random.key(seed)
+        fused = jax.jit(
+            lambda k, r: augment_normalize_pallas(
+                k, r, _MEAN, _STD, use_kernel=True)
+        )(key, raw_uint8)
+        ref = jax.jit(_unfused_ingest)(key, raw_uint8)
+        np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+
+    @pytest.mark.parametrize("use_kernel", [False, True])
+    def test_bf16_is_last_op_cast(self, raw_uint8, use_kernel):
+        """out_dtype=bfloat16 must equal the f32 result rounded ONCE at
+        the end (the scoring path's contract) — not a bf16 compute."""
+        key = jax.random.key(2)
+        fused = jax.jit(
+            lambda k, r: augment_normalize_pallas(
+                k, r, _MEAN, _STD, out_dtype=jnp.bfloat16,
+                use_kernel=use_kernel)
+        )(key, raw_uint8)
+        ref = jax.jit(
+            lambda k, r: _unfused_ingest(k, r, jnp.bfloat16)
+        )(key, raw_uint8)
+        assert fused.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(fused, np.float32), np.asarray(ref, np.float32))
+
+    def test_deterministic_per_key(self, raw_uint8):
+        key = jax.random.key(11)
+        a = augment_normalize_pallas(key, raw_uint8, _MEAN, _STD)
+        b = augment_normalize_pallas(key, raw_uint8, _MEAN, _STD)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
