@@ -52,7 +52,7 @@ import _bootstrap  # noqa: F401,E402
 import numpy as np  # noqa: E402
 
 
-def build(placement: str, args, fused: bool = False):
+def build(placement: str, args, fused: bool = False, mesh=None):
     from mercury_tpu.config import TrainConfig
     from mercury_tpu.parallel.mesh import make_mesh
     from mercury_tpu.train.trainer import Trainer
@@ -78,7 +78,8 @@ def build(placement: str, args, fused: bool = False):
         heartbeat_every=0,
         seed=0,
     )
-    return Trainer(config, mesh=make_mesh(args.world, config.mesh_axis))
+    return Trainer(config,
+                   mesh=mesh or make_mesh(args.world, config.mesh_axis))
 
 
 class ReplicatedArm:
@@ -217,6 +218,140 @@ def run_fused(args) -> int:
     return 0
 
 
+def run_stream_worker(args) -> int:
+    """One process of the ``--processes`` fan-out: joins the distributed
+    CPU cluster, streams on the GLOBAL mesh (each process's pipeline
+    gathers only its own workers' rows — ``stream_shard_mode`` auto →
+    "local"), and prints its per-host measurements as one ``PROC`` json
+    line for the coordinator to aggregate."""
+    import jax
+
+    from mercury_tpu.parallel import distributed
+
+    distributed.initialize(f"127.0.0.1:{args._port}", args.processes,
+                           args._worker)
+    mesh = distributed.global_mesh()
+    try:
+        stream = StreamArm(build("host_stream", args, mesh=mesh))
+        for _ in range(args.rounds):
+            stream.run_block(args.calls)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        # Same narrow marker as tests/_dist_worker.py: some jaxlib CPU
+        # builds form the cluster but cannot execute cross-process
+        # collectives — an environment limit, not a pipeline bug.
+        if "Multiprocess computations aren't implemented" in str(e):
+            print("SKIP: jax CPU backend cannot execute cross-process "
+                  "collectives in this build", flush=True)
+            return 0
+        raise
+    out = {
+        "process": args._worker,
+        "platform": jax.devices()[0].platform,
+        "local_workers": stream.trainer._stream_local_workers.tolist(),
+        "steps_per_s": round(stream.steps_per_s, 3),
+        "stall_fraction": round(stream.stall_fraction, 4),
+        "wait_fraction": round(
+            stream.wait_s / stream.timed_s if stream.timed_s else 0.0, 4),
+        "h2d_bytes_per_step": int(stream.h2d_bytes_per_step),
+        "block_rates": [round(r, 3) for r in stream.rates],
+    }
+    stream.trainer.close()
+    print("PROC " + json.dumps(out), flush=True)
+    return 0
+
+
+def run_multiproc(args, argv) -> int:
+    """``--processes N``: fan out N OS processes that form one JAX
+    distributed CPU cluster (N × world/N virtual devices = the world-sized
+    global mesh) and stream through it — the multi-controller host_stream
+    arm. Records per-host stall fractions and the aggregate steps/s (the
+    slowest host's: SPMD processes advance the same global step, so rates
+    don't sum)."""
+    import socket
+    import subprocess
+
+    if args.world % args.processes:
+        raise SystemExit(
+            f"--world {args.world} must be divisible by "
+            f"--processes {args.processes}")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "PALLAS_AXON_POOL_IPS")}
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                        f"{args.world // args.processes}")
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + list(argv)
+        + ["--_worker", str(pid), "--_port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+        for pid in range(args.processes)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=1200)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+
+    base = {
+        "schema": "input_stream_multiproc_v1",
+        "model": args.model,
+        "sampler": args.sampler,
+        "world_size": args.world,
+        "processes": args.processes,
+        "batch_size": args.batch,
+        "prefetch_depth": args.depth,
+        "decode_workers": args.decode_workers,
+        "calls": args.calls,
+        "rounds": args.rounds,
+        "smoke": bool(args.smoke),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    skip = [l for out in outs for l in out.splitlines()
+            if l.startswith("SKIP:")]
+    if skip and all(p.returncode == 0 for p in procs):
+        record = dict(base, skipped=skip[0])
+        with open(args.out, "a") as f:
+            f.write(json.dumps(record) + "\n")
+        print(json.dumps(record, indent=2))
+        return 0
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            print(out, file=sys.stderr)
+            raise SystemExit(f"--processes worker {pid} failed")
+    stats = sorted(
+        (json.loads(l[len("PROC "):])
+         for out in outs for l in out.splitlines() if l.startswith("PROC ")),
+        key=lambda s: s["process"],
+    )
+    assert len(stats) == args.processes, stats
+    record = dict(
+        base,
+        platform=stats[0]["platform"],
+        steps_per_s=round(min(s["steps_per_s"] for s in stats), 3),
+        per_host_steps_per_s=[s["steps_per_s"] for s in stats],
+        per_host_stall_fraction=[s["stall_fraction"] for s in stats],
+        max_stall_fraction=max(s["stall_fraction"] for s in stats),
+        per_host_wait_fraction=[s["wait_fraction"] for s in stats],
+        per_host_h2d_bytes_per_step=[s["h2d_bytes_per_step"]
+                                     for s in stats],
+    )
+    with open(args.out, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record, indent=2))
+    if record["max_stall_fraction"] > 0.10:
+        print(f"# WARNING: max per-host stall fraction "
+              f"{record['max_stall_fraction']:.1%} exceeds the 10% budget "
+              f"at prefetch_depth={args.depth} (CPU timing is noisy; rerun "
+              "with more --calls before reading much into it)",
+              file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="smallcnn")
@@ -235,11 +370,24 @@ def main(argv=None) -> int:
     ap.add_argument("--fused", action="store_true",
                     help="compare fused_input=True vs False host_stream "
                          "arms instead of host_stream vs replicated")
+    ap.add_argument("--processes", type=int, default=1,
+                    help="fan out N OS processes forming one distributed "
+                         "CPU cluster (the multi-controller host_stream "
+                         "arm; world/N virtual devices per process)")
+    ap.add_argument("--_worker", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--_port", type=int, default=None,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "results_input_stream.jsonl"))
     args = ap.parse_args(argv)
     if args.smoke:
         args.world, args.batch, args.calls, args.rounds = 4, 32, 10, 3
+
+    if args._worker is not None:
+        return run_stream_worker(args)
+    if args.processes > 1:
+        return run_multiproc(args, sys.argv[1:] if argv is None else argv)
 
     import jax
 

@@ -75,8 +75,11 @@ class TrainConfig:
     # compute. Device train-data memory drops from the full dataset to
     # prefetch_depth batches (+ the [L] score table for the scoretable
     # sampler — the only piece importance sampling needs on-device).
-    # Single-process only; requires sampler="pool"|"scoretable",
-    # scan_steps=1, no pipelined_scoring / score-refresh cadence.
+    # Multi-controller capable: each process runs its own prefetch
+    # pipeline over its local workers' rows (see stream_shard_mode) and
+    # device_puts only to its addressable shards — zero cross-host pixel
+    # traffic. Requires sampler="pool"|"scoretable", scan_steps=1, no
+    # pipelined_scoring / score-refresh cadence.
     data_placement: str = "replicated"
     # host_stream: how many batches the prefetch pipeline keeps in flight
     # (the lookahead distance of the in-graph index draw). The first
@@ -87,6 +90,28 @@ class TrainConfig:
     # decode (data/stream.py sources). 0 = gather inline on the single
     # prefetch thread.
     decode_workers: int = 0
+    # host_stream, multi-controller: which rows of the [W, S] index slab
+    # each process's prefetch pipeline gathers and transfers.
+    # - "auto": "local" when process_count > 1, "replicated" otherwise
+    #   (the single-process fast path is untouched);
+    # - "local": each process gathers ONLY its own workers' rows
+    #   (host_worker_slice) and device_puts them to its addressable
+    #   shards — the global streamed batch is assembled from per-host
+    #   slabs with zero cross-host pixel traffic. Forceable in a
+    #   single-process run to exercise the per-host assembly path
+    #   (that is how tier-1 covers it on CPU);
+    # - "replicated": the legacy single-pipeline full-slab path.
+    #   Rejected when process_count > 1: a process can only read its
+    #   addressable rows of the in-flight index output, so a full-slab
+    #   gather would need a collective from the prefetch thread.
+    stream_shard_mode: str = "auto"
+    # host_stream: carry the stream cursor + PendingSelection ring +
+    # scoretable through checkpoints (they are MercuryState fields, so
+    # same-world restores always resume exactly). Under restore_elastic
+    # this toggle gates the mid-epoch carry: True reshards the score
+    # table by new worker ownership and carries the epoch-fraction
+    # cursor; False restarts sampler state fresh at the restored step.
+    stream_checkpoint_cursor: bool = True
 
     # Optimization ----------------------------------------------------------
     batch_size: int = 32             # per-worker train batch (exp_dataset.py:11,24)
@@ -183,7 +208,9 @@ class TrainConfig:
     #   zero scoring FLOPs/collectives in the hot program (the graftlint
     #   `async` plan budgets enforce this), at the price of score ages
     #   measured in steps. Requires sampler="scoretable"; single-controller
-    #   (one-process) runs only.
+    #   (one-process) runs only — the fleet snapshots params and scores
+    #   against one process's table copy, with no cross-process
+    #   consistency protocol for the streamed (slots, scores) chunks.
     refresh_mode: str = "sync"
     # Async refresh only: background scoring threads. One is enough on the
     # CPU smoke; more overlap more scoring forwards with the hot loop when

@@ -175,11 +175,20 @@ class PrefetchPipeline:
     pop→step→push loop keeps exactly ``depth`` items in flight, so memory
     is ``(depth+1)`` staging-buffer-sized slabs, independent of dataset
     size. Worker exceptions re-raise on the next :meth:`pop`.
+
+    Multi-controller (``local_workers`` given): the pipeline becomes this
+    host's shard of a per-process fleet. ``batch_shape`` stays the GLOBAL
+    ``(W, S)``; the staging slabs shrink to this host's worker rows, the
+    worker gathers only those rows (splitting a global ``[W, S]`` index
+    output host-locally via its addressable shards), and the commit
+    assembles the global batch with ``jax.make_array_from_callback`` —
+    each process transfers only its addressable shards, so zero pixel
+    bytes ever cross hosts.
     """
 
     def __init__(self, source, batch_shape: Tuple[int, int], sharding,
                  depth: int = 2, pop_timeout_s: float = 300.0,
-                 tracer=None) -> None:
+                 tracer=None, local_workers=None) -> None:
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if tracer is None:
@@ -193,11 +202,24 @@ class PrefetchPipeline:
         self._sharding = sharding
         self._pop_timeout_s = float(pop_timeout_s)
         w, s = self._batch_shape
+        # Multi-controller: slabs hold only this host's worker rows of the
+        # global [W, S] batch; _staging_row maps global row → slab row for
+        # the drain split and the global-array assembly callback.
+        self._local_workers = (None if local_workers is None
+                               else np.asarray(local_workers, np.int64))
+        if self._local_workers is None:
+            slab_rows = w
+            self._staging_row = None
+        else:
+            slab_rows = int(self._local_workers.shape[0])
+            self._staging_row = {
+                int(g): i for i, g in enumerate(self._local_workers)
+            }
         # depth+1 rotating staging slabs: the worker gathers into slab i
         # while the commit copies out of slabs i-1…i-depth are still in
         # flight, so publishing a batch never has to wait for the device.
         self._staging = [
-            np.empty((w, s) + tuple(source.row_shape), source.dtype)
+            np.empty((slab_rows, s) + tuple(source.row_shape), source.dtype)
             for _ in range(self.depth + 1)
         ]
         self._inflight: list = [None] * (self.depth + 1)
@@ -331,6 +353,51 @@ class PrefetchPipeline:
             close()
 
     # -------------------------------------------------------------- worker
+    def _local_rows(self, idx) -> np.ndarray:
+        """This host's rows of one selection's indices, as host int32/64.
+
+        Accepts the three shapes a multi-controller driver can push: a
+        global ``[W, S]`` jax.Array sharded over the data axis (the step's
+        in-flight third output — only the addressable shards are readable
+        here, and they ARE this host's rows), a host ``[W, S]`` array
+        (sliced by ``local_workers``), or an already-local ``[Wl, S]``
+        array (passed through). Single-pipeline mode is a plain asarray.
+        """
+        if self._local_workers is None:
+            return np.asarray(idx)  # graftlint: disable=GL114 -- absorbing the index sync off the training thread is this worker's purpose
+        if hasattr(idx, "addressable_shards") and not getattr(
+                idx, "is_fully_addressable", True):
+            rows: Dict[int, np.ndarray] = {}
+            for sh in idx.addressable_shards:
+                start = sh.index[0].start or 0
+                data = np.asarray(sh.data)  # graftlint: disable=GL114 -- absorbing the index sync off the training thread is this worker's purpose
+                for j in range(data.shape[0]):
+                    rows[start + j] = data[j]
+            return np.stack([rows[int(g)] for g in self._local_workers])
+        arr = np.asarray(idx)  # graftlint: disable=GL114 -- absorbing the index sync off the training thread is this worker's purpose
+        if arr.shape[0] == self._local_workers.shape[0] \
+                and arr.shape[0] != self._batch_shape[0]:
+            return arr
+        return arr[self._local_workers]
+
+    def _assemble(self, staging: np.ndarray):
+        """Per-host slab → global ``[W, S, ...]`` array: each addressable
+        device's block is served from this host's staging rows via the
+        global-row map, so the construction never touches (or waits for)
+        another host's pixels."""
+        import jax
+
+        w, s = self._batch_shape
+        shape = (w, s) + tuple(self.source.row_shape)
+        row_of = self._staging_row
+
+        def cb(idx):
+            rows = range(*idx[0].indices(w))
+            block = np.stack([staging[row_of[r]] for r in rows])
+            return block[(slice(None),) + tuple(idx[1:])]
+
+        return jax.make_array_from_callback(shape, self._sharding, cb)
+
     def _publish(self, item) -> bool:
         """Bounded-wait put onto the ready queue with a close() escape
         hatch: a full queue means the trainer is behind — wait for room
@@ -368,9 +435,11 @@ class PrefetchPipeline:
                         prev.block_until_ready()  # graftlint: disable=GL114 -- staging-slab reuse fence; blocks only this worker
                 # The one real sync this thread exists to absorb: idx is
                 # the step's in-flight index output, and materializing it
-                # here means the TRAINING thread never waits for it.
+                # here means the TRAINING thread never waits for it. In
+                # multi-controller mode this is also the drain-side split:
+                # only this host's rows of the global selection are read.
                 with tracer.span("stream/wait_indices", cat="stream"):
-                    idx_h = np.asarray(idx)  # graftlint: disable=GL114 -- absorbing the index sync off the training thread is this worker's purpose
+                    idx_h = self._local_rows(idx)
                 t_ready = time.monotonic()
                 with tracer.span("stream/gather", cat="stream",
                                  rows=int(idx_h.size)):
@@ -380,7 +449,10 @@ class PrefetchPipeline:
                             (-1,) + tuple(self.source.row_shape)))
                 with tracer.span("stream/h2d", cat="stream",
                                  bytes=int(staging.nbytes)):
-                    batch = jax.device_put(staging, self._sharding)
+                    if self._local_workers is None:
+                        batch = jax.device_put(staging, self._sharding)
+                    else:
+                        batch = self._assemble(staging)
                     batch = self._commit(batch)
                 self._inflight[slot] = batch
                 self.total_h2d_bytes += int(staging.nbytes)

@@ -50,8 +50,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 SCHEMA = "graftlint_budgets_v1"
-PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "hs_fused", "sp", "pp",
-              "async")
+PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "hs_local", "hs_fused", "sp",
+              "pp", "async")
 
 # The seed step's metric surface — what telemetry=False must reproduce
 # exactly (mirrors benchmarks/telemetry_overhead.py::BASE_KEYS).
@@ -321,12 +321,18 @@ def _build_async():
     return trainer.train_step, args, dict(kw, plan="async")
 
 
-def _build_hs():
+def _build_hs(shard_mode: str = None):
     """host_stream dp: the lookahead step (``hs_body``) — pixels arrive
     as a streamed uint8 batch, the next selection's indices leave as a
     third output. The pixel argument is a shape/dtype template: tracing
     and AOT lowering never need values, and the audit must not depend on
-    the prefetch thread having produced anything."""
+    the prefetch thread having produced anything.
+
+    ``shard_mode="local"`` builds the multi-controller variant (per-host
+    slab + callback assembly on the drain side): its budget pins that
+    host-local assembly is a pure dataflow change — the traced step
+    program (jaxpr digest, collectives, donation of state AND slab) is
+    IDENTICAL to the full-slab plan's."""
     import jax
 
     from mercury_tpu.config import TrainConfig
@@ -352,13 +358,16 @@ def _build_hs():
         heartbeat_every=0,
         seed=0,
     )
+    if shard_mode is not None:
+        kw["stream_shard_mode"] = shard_mode
     config = TrainConfig(**kw)
     trainer = Trainer(config, mesh=make_mesh(2, config.mesh_axis))
     staging = trainer._stream_pipe._staging[0]
     x_t = jax.ShapeDtypeStruct(staging.shape, staging.dtype)
     args = (trainer.state, x_t, trainer._step_y,
             trainer.dataset.shard_indices)
-    return trainer.train_step, args, dict(kw, plan="hs")
+    plan = "hs" if shard_mode is None else f"hs_{shard_mode}"
+    return trainer.train_step, args, dict(kw, plan=plan)
 
 
 def _build_hs_fused():
@@ -477,6 +486,7 @@ _BUILDERS = {
     "zero": lambda: _build_fused("zero"),
     "dp_bf16": lambda: _build_fused("dp_bf16"),
     "hs": _build_hs,
+    "hs_local": lambda: _build_hs("local"),
     "hs_fused": _build_hs_fused,
     "sp": _build_sp,
     "pp": _build_pp,

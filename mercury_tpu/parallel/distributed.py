@@ -163,6 +163,10 @@ def globalize_state(state, mesh: Mesh, axis_name: str = "data",
         pending=None if state.pending is None else shd(state.pending),
         cached_pool=(None if state.cached_pool is None
                      else shd(state.cached_pool)),
+        scoretable=(None if state.scoretable is None
+                    else shd(state.scoretable)),
+        pending_sel=(None if state.pending_sel is None
+                     else shd(state.pending_sel)),
     )
 
 

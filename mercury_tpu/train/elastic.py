@@ -28,6 +28,21 @@ What transfers and what re-derives, by world-size dependence:
   value): the new workers warm-start from the old workers' mean instead
   of re-bootstrapping, so the importance scores stay smoothed through the
   topology change.
+- **score table + stream cursor** (``config.stream_checkpoint_cursor``,
+  default on) — per-SAMPLE state wearing per-worker clothes: a table
+  entry scores dataset row ``shard_indices[w, l]``, and the partition is
+  deterministic in ``(labels, W, seed)``, so both the old and the new
+  ``[W, L]`` index matrices can be recomputed host-side and the scores
+  REPARTITIONED by new worker ownership (rows that changed hands keep
+  their learned scores; rows the old run never held warm-start at the
+  EMA mean). The shard-stream and refresh cursors carry as epoch
+  fractions — a run preempted 60% through its shard sweep resumes ~60%
+  through the new one instead of restarting the epoch.
+- **host_stream's pending_sel ring** — genuinely in-flight (the
+  selections reference old-world slots whose pixels were never
+  streamed): re-primed by the caller (``Trainer.restore_elastic`` runs
+  ``make_host_stream_prime`` on the restored, step-folded RNG) for the
+  new topology.
 """
 
 from __future__ import annotations
@@ -155,6 +170,84 @@ def _reshard_zero_opt(old_opt: Any, new_opt: Any, w_old: int, w_new: int,
     return jax.tree_util.tree_map(leaf, old_opt, new_opt)
 
 
+def _shard_index_matrix(trainer, n_workers: int) -> np.ndarray:
+    """Recompute the ``[W, L]`` cyclically-tiled shard-index matrix a
+    ``n_workers``-way run of this config builds (``partition_data`` is
+    deterministic in ``(labels, W, seed)``; tiling mirrors
+    ``make_sharded_dataset``) — elastic can then map per-worker state to
+    per-SAMPLE state for any world size without reading the live (possibly
+    non-addressable) device copy."""
+    from mercury_tpu.data.partition import partition_data
+
+    labels = np.asarray(jax.device_get(trainer.dataset.y_train))
+    cfg = trainer.config
+    shards = partition_data(
+        labels, n_workers,
+        mode="hetero" if cfg.noniid else "homo",
+        alpha=cfg.dirichlet_alpha, seed=cfg.seed,
+        min_size=cfg.min_shard_size,
+    )
+    max_len = max(len(s) for s in shards)
+    rows = []
+    for s in shards:
+        reps = int(np.ceil(max_len / len(s)))
+        rows.append(np.tile(s, reps)[:max_len])
+    return np.stack(rows).astype(np.int64)
+
+
+def _carry_streamed_state(trainer, old: Any, template: MercuryState,
+                          w_old: int, w_new: int, ema_val: float) -> dict:
+    """Mid-epoch sampler-state carry across a ``(W, L)`` change (gated by
+    ``config.stream_checkpoint_cursor``): repartition the score table's
+    per-sample scores by new worker ownership and carry the shard-stream /
+    refresh cursors as epoch fractions. Returns replace() kwargs."""
+    import jax.numpy as jnp
+
+    extra: dict = {}
+    old_stream = getattr(old, "stream", None)
+    if old_stream is not None and np.size(
+            np.asarray(old_stream.cursor)) == w_old:
+        l_old = int(np.shape(old_stream.perm)[1])
+        l_new = int(np.shape(template.stream.perm)[1])
+        frac = float(np.mean(
+            np.asarray(old_stream.cursor, np.float64)) / max(l_old, 1))
+        cursor = np.full((w_new,),
+                         min(int(frac * l_new), l_new), np.int32)
+        extra["stream"] = type(template.stream)(
+            perm=jnp.asarray(np.asarray(template.stream.perm)),
+            cursor=jnp.asarray(cursor),
+        )
+    old_tab = getattr(old, "scoretable", None)
+    new_tab = template.scoretable
+    if old_tab is not None and new_tab is not None:
+        old_scores = np.asarray(old_tab.scores, np.float32)
+        l_old = int(old_scores.shape[1])
+        l_new = int(np.shape(new_tab.scores)[1])
+        old_sidx = _shard_index_matrix(trainer, w_old)
+        new_sidx = _shard_index_matrix(trainer, w_new)
+        if old_sidx.shape != (w_old, l_old) \
+                or new_sidx.shape != (w_new, l_new):
+            # The recomputed partition disagrees with the live shapes
+            # (config drift?) — fall back to the fresh template table.
+            return extra
+        n = int(np.asarray(jax.device_get(trainer.dataset.y_train)).size)
+        # Samples the old run never owned (partition boundaries moved)
+        # warm-start at the EMA mean — exactly where table_decay pulls
+        # never-refreshed entries anyway. Cyclic-tiling duplicates write
+        # last-wins; their scores differ only by refresh age.
+        global_scores = np.full((n,), ema_val, np.float32)
+        global_scores[old_sidx.reshape(-1)] = old_scores.reshape(-1)
+        frac = float(np.mean(
+            np.asarray(old_tab.cursor, np.float64)) / max(l_old, 1))
+        cursor = np.full((w_new,),
+                         int(frac * l_new) % max(l_new, 1), np.int32)
+        extra["scoretable"] = type(new_tab)(
+            scores=jnp.asarray(global_scores[new_sidx], jnp.float32),
+            cursor=jnp.asarray(cursor),
+        )
+    return extra
+
+
 def _check_same(old: Any, new: Any, what: str) -> Any:
     def leaf(o, n):
         if np.shape(o) != np.shape(n):
@@ -222,6 +315,15 @@ def elastic_restore(directory: str, trainer,
         template.rng
     )
 
+    # Mid-epoch carry (config.stream_checkpoint_cursor): score table
+    # repartitioned by new worker ownership, shard-stream + refresh
+    # cursors carried as epoch fractions. Off → those fields keep the
+    # template's fresh initialization.
+    extra = {}
+    if getattr(trainer.config, "stream_checkpoint_cursor", True):
+        extra = _carry_streamed_state(trainer, old, template, w_old, w_new,
+                                      ema_val)
+
     trainer.state = template.replace(
         step=jnp.asarray(int(old.step), jnp.int32),
         params=jax.tree_util.tree_map(jnp.asarray, params),
@@ -229,8 +331,10 @@ def elastic_restore(directory: str, trainer,
         opt_state=jax.tree_util.tree_map(jnp.asarray, opt_state),
         ema=ema,
         rng=rng,
-        # stream/groupwise/pending/cached_pool: the template's fresh,
-        # deterministic initialization over the NEW partition.
+        # groupwise/pending/cached_pool/pending_sel: the template's fresh,
+        # deterministic initialization over the NEW partition (host_stream
+        # re-primes pending_sel in Trainer.restore_elastic).
+        **extra,
     )
     # Re-placement (global arrays multi-controller, committed TP layout)
     # is the caller's job — Trainer.restore_elastic runs the same

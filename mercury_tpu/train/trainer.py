@@ -324,6 +324,7 @@ class Trainer:
         # dataset is globalized (it reads the process-local host copy,
         # identical on every process by seeded construction).
         data_sharded = config.data_placement == "sharded"
+        host_stream = config.data_placement == "host_stream"
         if data_sharded:
             from mercury_tpu.parallel.distributed import (
                 worker_shard_global_arrays,
@@ -332,6 +333,13 @@ class Trainer:
             self._step_x, self._step_y = worker_shard_global_arrays(
                 self.dataset, self.mesh, config.mesh_axis
             )
+        if host_stream:
+            # Stashed BEFORE the dataset is globalized (the [W, L] matrix
+            # becomes a non-addressable P(data) array under
+            # multi-controller): the drain-side slot→global-row mapping
+            # (_refill_stream_pipe) needs the full host copy, which every
+            # process holds identically by seeded construction.
+            self._host_shard_indices = np.asarray(self.dataset.shard_indices)
         if jax.process_count() > 1:
             from mercury_tpu.parallel.distributed import (
                 globalize_dataset,
@@ -364,7 +372,11 @@ class Trainer:
                 self.state = self.state.replace(opt_state=tp_opt)
             self.dataset = globalize_dataset(
                 self.dataset, self.mesh, config.mesh_axis,
-                include_train_arrays=not data_sharded,
+                # host_stream: pixels must STAY host numpy — the per-host
+                # prefetch pipelines stream selected rows; replicating
+                # x_train onto every device is the thing the placement
+                # exists to avoid.
+                include_train_arrays=not data_sharded and not host_stream,
             )
         if params_sharded:
             # The moment layout is DERIVED (opt_sharding_like), not
@@ -406,7 +418,6 @@ class Trainer:
                 # globalize_state.)
                 state_sh, _ = self._state_out_shardings
                 self.state = jax.device_put(self.state, state_sh)
-        host_stream = config.data_placement == "host_stream"
         if host_stream:
             # Pixels never become a step input: _step_x is the per-step
             # streamed batch (popped from the prefetch pipeline in
@@ -455,6 +466,18 @@ class Trainer:
         eval_mesh = (self.mesh
                      if jax.process_count() == 1 and not params_sharded
                      else None)
+        if jax.process_count() > 1:
+            # Not a silent restriction: multi-controller eval still RUNS
+            # (plain jit over host-replicated eval arrays), but every
+            # process executes the full pass redundantly instead of
+            # sharding batches over the mesh — sharded eval would need
+            # globally-placed eval arrays, which nothing builds yet.
+            _log.warning(
+                "multi-controller run (%d processes): evaluation executes "
+                "replicated — every process runs the full eval pass "
+                "redundantly (correct, but no eval speedup from the mesh)",
+                jax.process_count(),
+            )
         self.eval_epoch = make_eval_epoch(self.model, self.dataset.mean,
                                           self.dataset.std,
                                           eval_augmentation=config.augmentation
@@ -574,21 +597,38 @@ class Trainer:
         # Built BEFORE auto_resume: a restore re-seeds the ring and the
         # pipeline via _recommit_state → _refill_stream_pipe.
         self._stream_pipe = None
+        self._stream_local_workers = None
         if host_stream:
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "data_placement='host_stream' is single-controller "
-                    "only: the prefetch worker gathers from one host's "
-                    "copy of the dataset"
-                )
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
             from mercury_tpu.data.stream import (
                 HostStreamSource,
                 PrefetchPipeline,
             )
+            from mercury_tpu.parallel.distributed import host_worker_slice
             from mercury_tpu.train.step import make_host_stream_prime
 
+            # Multi-controller: each process runs its own pipeline over
+            # its local workers' rows and device_puts only to its
+            # addressable shards — the global streamed batch is assembled
+            # per-host with zero cross-host pixel traffic.
+            shard_mode = config.stream_shard_mode
+            if shard_mode not in ("auto", "local", "replicated"):
+                raise ValueError(
+                    f"stream_shard_mode={shard_mode!r}: expected one of "
+                    "'auto', 'local', 'replicated'")
+            if shard_mode == "auto":
+                shard_mode = ("local" if jax.process_count() > 1
+                              else "replicated")
+            if shard_mode == "replicated" and jax.process_count() > 1:
+                raise ValueError(
+                    "stream_shard_mode='replicated' is single-process "
+                    "only: a multi-controller process can read only its "
+                    "addressable rows of the in-flight index output — "
+                    "use 'local' (the multi-controller default)")
+            if shard_mode == "local":
+                self._stream_local_workers = host_worker_slice(
+                    self.mesh, config.mesh_axis)
             source = HostStreamSource(
                 np.asarray(self.dataset.x_train),
                 decode_workers=config.decode_workers,
@@ -602,13 +642,13 @@ class Trainer:
                 self._stream_x_sharding,
                 depth=config.prefetch_depth,
                 tracer=self.tracer,
+                local_workers=self._stream_local_workers,
             )
             self._stream_prime = make_host_stream_prime(config, self.mesh)
             self.state, primed_gidx = self._stream_prime(
                 self.state, self.dataset.shard_indices
             )
-            for i in range(config.prefetch_depth):
-                self._stream_pipe.push(primed_gidx[i])
+            self._seed_stream_pipe(primed_gidx)
             # The streamed-x step has no host-side x template for the XLA
             # cost model (analytic_flops_per_step reads _step_x); skip the
             # lazy fill — mfu reports 0.0, steps/s and examples/s remain.
@@ -628,8 +668,10 @@ class Trainer:
             if jax.process_count() > 1:
                 raise ValueError(
                     "refresh_mode='async' is single-controller only: the "
-                    "scorer fleet scores from one host's copy of the "
-                    "dataset (like data_placement='host_stream')"
+                    "scorer fleet's params snapshot and its (slots, "
+                    "scores) chunk stream are per-process, with no "
+                    "cross-process protocol to keep every host's score "
+                    "table consistent"
                 )
             from mercury_tpu.sampling.scorer_fleet import ScorerFleet
 
@@ -744,6 +786,31 @@ class Trainer:
             self._stream_pipe.push(next_gidx)
         return metrics
 
+    def _seed_stream_pipe(self, primed_gidx) -> None:
+        """Push the primed ``[depth, W, S]`` selections into the prefetch
+        pipeline, reset first (queued work belongs to a previous
+        trajectory). Multi-controller: only this host's worker rows of
+        the ``P(None, data)``-sharded prime output are readable here —
+        and they are exactly the rows this host's pipeline gathers."""
+        self._stream_pipe.reset()
+        lw = self._stream_local_workers
+        if lw is None:
+            for i in range(self.config.prefetch_depth):
+                self._stream_pipe.push(primed_gidx[i])
+            return
+        if getattr(primed_gidx, "is_fully_addressable", True):
+            local = np.asarray(jax.device_get(primed_gidx))[:, lw]
+        else:
+            rows: Dict[int, np.ndarray] = {}
+            for sh in primed_gidx.addressable_shards:
+                start = sh.index[1].start or 0
+                data = np.asarray(sh.data)       # [depth, nw, S]
+                for j in range(data.shape[1]):
+                    rows[start + j] = data[:, j]
+            local = np.stack([rows[int(g)] for g in lw], axis=1)
+        for i in range(self.config.prefetch_depth):
+            self._stream_pipe.push(local[i])
+
     def _refill_stream_pipe(self) -> None:
         """Re-seed the prefetch pipeline from ``state.pending_sel`` after a
         checkpoint restore: every in-flight batch belongs to the previous
@@ -754,14 +821,28 @@ class Trainer:
             return
         with self.tracer.span("trainer/refill_stream_pipe", cat="trainer"):
             self._stream_pipe.reset()
-            # [W, depth, S] shard-local slots → global ids via the host
-            # copy of the shard index table.
-            slots = np.asarray(jax.device_get(self.state.pending_sel.slots))
-            shard_indices = np.asarray(self.dataset.shard_indices)
+            # [W, depth, S] shard-local slots → global ids via the HOST
+            # copy of the shard index table (the globalized device copy is
+            # not addressable across hosts). Multi-controller reads only
+            # this host's worker rows of the P(data)-sharded slots.
+            slots_arr = self.state.pending_sel.slots
+            if getattr(slots_arr, "is_fully_addressable", True):
+                slots = np.asarray(jax.device_get(slots_arr))
+                workers = np.arange(slots.shape[0])
+            else:
+                owned: Dict[int, np.ndarray] = {}
+                for sh in slots_arr.addressable_shards:
+                    start = sh.index[0].start or 0
+                    data = np.asarray(sh.data)   # [nw, depth, S]
+                    for j in range(data.shape[0]):
+                        owned[start + j] = data[j]
+                workers = np.asarray(sorted(owned))
+                slots = np.stack([owned[int(w)] for w in workers])
+            shard_indices = self._host_shard_indices
             for d in range(slots.shape[1]):
                 gidx = np.stack([
-                    shard_indices[w][slots[w, d]]
-                    for w in range(slots.shape[0])
+                    shard_indices[w][slots[i, d]]
+                    for i, w in enumerate(workers)
                 ])
                 self._stream_pipe.push(gidx)
 
@@ -1255,7 +1336,7 @@ class Trainer:
         assert directory, "no checkpoint directory configured"
         return ckpt.save_checkpoint(directory, self.state, int(self.state.step))
 
-    def _recommit_state(self) -> None:
+    def _recommit_state(self, reprime_stream: bool = False) -> None:
         """Re-place a host-resident ``self.state`` for this trainer's
         topology: global arrays over the cross-process mesh
         (multi-controller), and/or the committed Megatron TP layout —
@@ -1283,41 +1364,53 @@ class Trainer:
                 self.state, self.mesh, self.config.mesh_axis,
                 zero_sharding=self.config.zero_sharding, **tp_kw,
             )
-            return
-        if self._state_out_shardings is not None:
-            state_sh, _ = self._state_out_shardings
         else:
-            # Non-TP: params/opt replicated, sampler state sharded over
-            # the data axis — the same layout the step program produces.
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
-            from mercury_tpu.train.step import mercury_state_out_shardings
+            if self._state_out_shardings is not None:
+                state_sh, _ = self._state_out_shardings
+            else:
+                # Non-TP: params/opt replicated, sampler state sharded
+                # over the data axis — the same layout the step program
+                # produces.
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                from mercury_tpu.train.step import (
+                    mercury_state_out_shardings,
+                )
 
-            cfg = self.config
-            rep = NamedSharding(self.mesh, P())
-            state_sh, _ = mercury_state_out_shardings(
-                self.mesh, cfg.mesh_axis, rep, rep,
-                has_groupwise=(cfg.use_importance_sampling
-                               and cfg.sampler == "groupwise"),
-                has_pending=(cfg.use_importance_sampling
-                             and cfg.pipelined_scoring),
-                has_cached_pool=(cfg.use_importance_sampling
-                                 and cfg.sampler == "pool"
-                                 and cfg.score_refresh_every > 1),
-                has_scoretable=(cfg.use_importance_sampling
-                                and cfg.sampler == "scoretable"),
-                has_pending_sel=(cfg.data_placement == "host_stream"),
+                cfg = self.config
+                rep = NamedSharding(self.mesh, P())
+                state_sh, _ = mercury_state_out_shardings(
+                    self.mesh, cfg.mesh_axis, rep, rep,
+                    has_groupwise=(cfg.use_importance_sampling
+                                   and cfg.sampler == "groupwise"),
+                    has_pending=(cfg.use_importance_sampling
+                                 and cfg.pipelined_scoring),
+                    has_cached_pool=(cfg.use_importance_sampling
+                                     and cfg.sampler == "pool"
+                                     and cfg.score_refresh_every > 1),
+                    has_scoretable=(cfg.use_importance_sampling
+                                    and cfg.sampler == "scoretable"),
+                    has_pending_sel=(cfg.data_placement == "host_stream"),
+                )
+            # Identity jit, not a bare device_put: on CPU device_put may
+            # zero-copy alias the checkpoint reader's host buffers, and
+            # the first donated step would then hand XLA memory it
+            # doesn't own. Executable outputs are always XLA-allocated.
+            self.state = jax.jit(lambda s: s, out_shardings=state_sh)(
+                jax.device_put(self.state, state_sh)
             )
-        # Identity jit, not a bare device_put: on CPU device_put may
-        # zero-copy alias the checkpoint reader's host buffers, and the
-        # first donated step would then hand XLA memory it doesn't own.
-        # Executable outputs are always XLA-allocated.
-        self.state = jax.jit(lambda s: s, out_shardings=state_sh)(
-            jax.device_put(self.state, state_sh)
-        )
-        # The restored pending_sel ring defines steps t..t+depth-1's
-        # selections; re-seed the prefetch pipeline with their rows.
-        self._refill_stream_pipe()
+        if reprime_stream and getattr(self, "_stream_pipe", None) is not None:
+            # Elastic restore: the live ring was drawn for the OLD (W, L)
+            # topology — regenerate depth in-flight selections from the
+            # restored (step-folded) rng and seed the pipeline with them.
+            self.state, primed_gidx = self._stream_prime(
+                self.state, self.dataset.shard_indices
+            )
+            self._seed_stream_pipe(primed_gidx)
+        else:
+            # The restored pending_sel ring defines steps t..t+depth-1's
+            # selections; re-seed the prefetch pipeline with their rows.
+            self._refill_stream_pipe()
         # Async fleet: queued chunks scored the pre-restore trajectory —
         # discard them and re-snapshot from the restored params (a restore
         # is already a sync point, so the int() here costs nothing new).
@@ -1338,18 +1431,17 @@ class Trainer:
         (``pytorch_collab.py:291-292``)."""
         from mercury_tpu.train.elastic import elastic_restore
 
-        if self.config.data_placement == "host_stream":
-            raise ValueError(
-                "restore_elastic does not support host_stream: the "
-                "elastic path re-derives per-worker sampler state, which "
-                "would orphan the checkpointed pending_sel ring (the "
-                "in-flight selections are per-worker). Restore at the "
-                "original world size instead."
-            )
         directory = directory or self.config.checkpoint_dir
         assert directory, "no checkpoint directory configured"
         step = elastic_restore(directory, self, step, raw=raw)
-        self._recommit_state()
+        # host_stream: the checkpointed pending_sel ring indexes the OLD
+        # (W, L) shard matrix — after elastic_restore carried the score
+        # table and stream cursor across, re-prime the lookahead ring for
+        # the new topology (make_host_stream_prime on the restored,
+        # step-folded rng) and seed each host's pipeline from it.
+        self._recommit_state(
+            reprime_stream=self.config.data_placement == "host_stream"
+        )
         return step
 
     def restore(self, directory: Optional[str] = None, step: Optional[int] = None) -> int:

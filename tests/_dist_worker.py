@@ -16,9 +16,12 @@ hang is the test's failure signal.
 import os
 import sys
 
+# --solo: 1-process reference/elastic arm (8 virtual devices — the whole
+# cluster in one process); workers get 4 each.
+_SOLO = len(sys.argv) > 1 and sys.argv[1] == "--solo"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=4"
+    + f" --xla_force_host_platform_device_count={8 if _SOLO else 4}"
 ).strip()
 # Keep the remote-TPU plugin (sitecustomize) from claiming the backend.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -262,14 +265,131 @@ def main(port: str, pid: int) -> None:
     assert np.isfinite(el), el
     assert int(tr_e4.state.step) == 3
 
+    # 10. host_stream, multi-controller: stream_shard_mode auto→"local" —
+    #     each process's prefetch pipeline gathers ONLY its own workers'
+    #     rows and device_puts them to its addressable shards; the global
+    #     streamed batch assembles from per-host slabs. The pool sampler's
+    #     lookahead replays the replicated RNG chain, so the streamed
+    #     trajectory must equal section 4's replicated one bit-for-bit
+    #     (and test_distributed.py checks it against a 1-process run too).
+    hs_ckpt = os.path.join(ckpt_dir, "hs")
+    tr_hs = Trainer(cfg.replace(data_placement="host_stream",
+                                prefetch_depth=2, checkpoint_dir=hs_ckpt),
+                    mesh=mesh)
+    assert tr_hs._stream_local_workers is not None
+    assert tr_hs._stream_local_workers.tolist() == mine.tolist()
+    hs_losses = [float(tr_hs._host_stream_step()["train/loss"])
+                 for _ in range(2)]
+    assert hs_losses == losses, (hs_losses, losses)
+    hl = hs_losses[-1]
+    tr_hs.save()
+    tr_hs.close()
+
+    # 11. host_stream scoretable, checkpointed mid-epoch: the score table
+    #     and cursors ride the checkpoint (stream_checkpoint_cursor);
+    #     test_distributed.py hands this directory to a SOLO 1-process run
+    #     that restores it elastically W=8 → W=4 — the 2→1-process world
+    #     change — and checks the streamed-state carry.
+    sc_ckpt = os.path.join(ckpt_dir, "hs_sc")
+    tr_sc = Trainer(cfg.replace(data_placement="host_stream",
+                                prefetch_depth=2, sampler="scoretable",
+                                checkpoint_dir=sc_ckpt),
+                    mesh=mesh)
+    sc_losses = [float(tr_sc._host_stream_step()["train/loss"])
+                 for _ in range(2)]
+    assert all(np.isfinite(l) for l in sc_losses), sc_losses
+    scl = sc_losses[-1]
+    tr_sc.save()
+    tr_sc.close()
+
     # Full precision (hex) so the cross-process comparison is bit-for-bit.
     print(f"OK {psum_val} {pmean_val} {mine.tolist()} "
           f"loss={losses[-1].hex()} post={post.hex()} zero={zloss.hex()} "
           f"sharded={sl.hex()} sharded_frac={local_bytes/full_bytes:.3f} "
-          f"tp={tl.hex()} elastic={el.hex()}",
+          f"tp={tl.hex()} elastic={el.hex()} "
+          f"hs={hl.hex()} sc={scl.hex()}",
           flush=True)
+
+
+def solo(ckpt_dir: str) -> None:
+    """1-process arm: (a) the same 8-worker host_stream pool config on 8
+    local virtual devices — its trajectory must match the 2-process
+    cluster's bit-for-bit (the multi-controller split is a pure dataflow
+    change); (b) elastic restore of the cluster's mid-epoch host_stream
+    checkpoints into ONE process at W=4 — the 2→1-process world change —
+    asserting the stream cursor and the score table survive."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.train.trainer import Trainer
+
+    assert jax.local_device_count() == 8
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    cfg = TrainConfig(
+        model="smallcnn", dataset="synthetic", world_size=8,
+        batch_size=4, presample_batches=2, steps_per_epoch=2, num_epochs=1,
+        eval_every=0, log_every=0, compute_dtype="float32", seed=0,
+    )
+    tr = Trainer(cfg.replace(data_placement="host_stream",
+                             prefetch_depth=2), mesh=mesh)
+    hs_losses = [float(tr._host_stream_step()["train/loss"])
+                 for _ in range(2)]
+    tr.close()
+    print(f"SOLO hs={hs_losses[-1].hex()}", flush=True)
+
+    from mercury_tpu.train.elastic import (
+        _shard_index_matrix,
+        probe_checkpoint,
+    )
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("data",))
+    # Pool arm: the shard-stream cursor carries as an epoch fraction — the
+    # restored cursor sits strictly past a fresh trainer's primed one.
+    tr_p = Trainer(cfg.replace(world_size=4, data_placement="host_stream",
+                               prefetch_depth=2,
+                               checkpoint_dir=os.path.join(ckpt_dir, "hs")),
+                   mesh=mesh4)
+    fresh = np.asarray(tr_p.state.stream.cursor).copy()
+    assert tr_p.restore_elastic() == 2
+    after = np.asarray(tr_p.state.stream.cursor)
+    assert np.all(after > fresh), (after, fresh)
+    lp = float(tr_p._host_stream_step()["train/loss"])
+    assert np.isfinite(lp), lp
+    tr_p.close()
+
+    # Scoretable arm: per-sample scores repartition by new worker
+    # ownership — every sample the 8-way run owned keeps its learned
+    # score bit-exactly under the 4-way index matrix.
+    sc_dir = os.path.join(ckpt_dir, "hs_sc")
+    raw, _ = probe_checkpoint(sc_dir, strict=True)
+    tr_s = Trainer(cfg.replace(world_size=4, data_placement="host_stream",
+                               prefetch_depth=2, sampler="scoretable",
+                               checkpoint_dir=sc_dir),
+                   mesh=mesh4)
+    assert tr_s.restore_elastic() == 2
+    old_scores = np.asarray(raw["scoretable"]["scores"], np.float32)
+    ema_val = float(np.mean(np.asarray(raw["ema"]["value"])))
+    old_sidx = _shard_index_matrix(tr_s, 8)
+    new_sidx = _shard_index_matrix(tr_s, 4)
+    assert old_sidx.shape == old_scores.shape, (old_sidx.shape,
+                                                old_scores.shape)
+    n = int(np.asarray(tr_s.dataset.y_train).size)
+    want = np.full((n,), ema_val, np.float32)
+    want[old_sidx.reshape(-1)] = old_scores.reshape(-1)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(tr_s.state.scoretable.scores)),
+        want[new_sidx],
+    )
+    ls = float(tr_s._host_stream_step()["train/loss"])
+    assert np.isfinite(ls), ls
+    tr_s.close()
+    print("SOLO elastic_ok", flush=True)
 
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
-    main(sys.argv[1], int(sys.argv[2]))
+    if _SOLO:
+        solo(sys.argv[2])
+    else:
+        main(sys.argv[1], int(sys.argv[2]))

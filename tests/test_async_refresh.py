@@ -195,6 +195,17 @@ class TestAsyncTrainer:
         with pytest.raises(ValueError):
             Trainer(async_cfg(**bad), mesh=mesh)
 
+    def test_multiprocess_rejected_names_fleet_constraint(self, mesh,
+                                                          monkeypatch):
+        """Multi-controller async refresh is rejected, and the message
+        names the REAL constraint — the fleet's per-process params
+        snapshot and (slots, scores) chunk stream — not a stale
+        single-controller precedent (host_stream no longer is one)."""
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(ValueError,
+                           match="scorer fleet.*per-process"):
+            Trainer(async_cfg(), mesh=mesh)
+
 
 class TestTrainerClose:
     """Trainer.close() regression: idempotent, ordering-safe, and safe on

@@ -65,11 +65,29 @@ def test_two_process_cluster_collectives(tmp_path):
         assert "OK 12.0 3.5" in out, f"worker {pid} wrong result:\n{out}"
     # Both processes ran the same global program — the training losses
     # (replicated global scalars, printed as float hex) must match
-    # bit-for-bit, including the post-checkpoint-restore step. (The
-    # worker-row slices legitimately differ per host: [0-3] vs [4-7].)
-    losses = []
+    # bit-for-bit, including the post-checkpoint-restore step and the
+    # host_stream trajectories. (The worker-row slices legitimately
+    # differ per host: [0-3] vs [4-7].)
+    losses, hs_hex = [], []
     for out in outs:
         line = [l for l in out.splitlines() if l.startswith("OK")][0]
         losses.append(line.split("loss=")[1])
+        hs_hex.append(line.split(" hs=")[1].split()[0])
         assert ("[0, 1, 2, 3]" in line) or ("[4, 5, 6, 7]" in line), line
     assert losses[0] == losses[1], f"losses diverge: {losses}"
+
+    # Solo arm: re-run the host_stream pool config in ONE process (8 local
+    # devices) — the per-host prefetch split must be a pure dataflow
+    # change, so the 2-process streamed trajectory matches the 1-process
+    # one bit-for-bit. The solo run then restores the cluster's mid-epoch
+    # host_stream checkpoints elastically (W=8 → W=4, 2 processes → 1),
+    # asserting the stream cursor and score table survive the world change.
+    solo = subprocess.run(
+        [sys.executable, WORKER, "--solo", env["MERCURY_TEST_CKPT_DIR"]],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, timeout=540,
+    )
+    assert solo.returncode == 0, f"solo arm failed:\n{solo.stdout}"
+    assert "SOLO elastic_ok" in solo.stdout, solo.stdout
+    solo_hs = solo.stdout.split("SOLO hs=")[1].split()[0]
+    assert all(h == solo_hs for h in hs_hex), (hs_hex, solo_hs)
