@@ -35,7 +35,10 @@ a stale/degraded/failed record, a cached record older than
 JSON line (with the violations attached). ``--stale-check-only``
 evaluates the committed ``bench_last_good.json`` without measuring —
 stdlib-only, no jax import, so CI can run the gate on machines with no
-accelerator stack.
+accelerator stack. The gate also re-asserts graftlint Layer P's
+scoring-FLOP ceiling on the committed ``lint/perf_budgets.json`` (a
+plan whose committed scoring fraction breaches its ceiling is an SLO
+violation here too, not just a lint failure).
 """
 
 from __future__ import annotations
@@ -465,6 +468,39 @@ def slo_violations(record: dict | None,
     return out
 
 
+#: Committed Layer P golden (graftlint perf budgets). Stdlib json read —
+#: the --stale-check-only path judges it without importing jax.
+PERF_BUDGETS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "mercury_tpu", "lint", "perf_budgets.json")
+
+
+def scoring_flop_violations(budgets_path: str = PERF_BUDGETS) -> list:
+    """Scoring-FLOP ceiling breaches in the committed perf budgets.
+
+    Re-asserts graftlint Layer P's hard contract from the SLO gate: for
+    every plan that scores (scoring_flop_frac > 0), the committed
+    fraction of step FLOPs spent inside ``mercury_scoring`` must sit at
+    or under its committed ceiling. Pure stdlib — a missing golden is
+    reported (the contract is unverifiable), not silently passed."""
+    try:
+        with open(budgets_path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return [f"perf budgets missing ({budgets_path}) — scoring-FLOP "
+                "ceiling unverifiable; run python -m mercury_tpu.lint "
+                "--layer perf --regen"]
+    except Exception as e:
+        return [f"perf budgets unreadable ({type(e).__name__}: {e})"]
+    out: list = []
+    for plan, b in sorted(doc.get("plans", {}).items()):
+        frac = b.get("scoring_flop_frac", 0.0)
+        ceiling = b.get("scoring_frac_ceiling", 0.0)
+        if frac > 0 and frac > ceiling + 1e-9:
+            out.append(f"plan '{plan}': committed scoring FLOP fraction "
+                       f"{frac:g} exceeds its ceiling {ceiling:g}")
+    return out
+
+
 def _parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument(
@@ -528,6 +564,9 @@ def _apply_slo_gate(record: dict | None, args) -> int:
     """Attach violations to the record, report, and pick the exit code."""
     violations = slo_violations(record, mfu_floor=args.mfu_floor,
                                 max_age_h=args.max_stale_age_h)
+    # Scoring-FLOP ceiling (graftlint Layer P contract), judged on the
+    # committed perf budgets — independent of the bench record itself.
+    violations += scoring_flop_violations()
     if record is not None and violations:
         record["slo_violations"] = violations
     for v in violations:

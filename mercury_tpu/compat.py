@@ -94,3 +94,49 @@ except ImportError:  # legacy jax has no vma type system (and the
         """Identity on legacy jax; vma cast on modern jax."""
         del axis_name, to
         return x
+
+
+def register_compile_listener(callback):
+    """Subscribe ``callback(event_name)`` to jax's trace/compile events.
+
+    On jax builds that ship ``jax.monitoring``, the duration events
+    ``.../jaxpr_trace_duration`` and ``.../backend_compile_duration``
+    fire once per trace / per XLA compile — exactly the signal the
+    retrace guard (lint/tracecheck.py) counts. Listener registration is
+    permanent on these jax versions (there is no per-listener
+    unregister, only a clear-all that would stomp other subscribers),
+    so callers install ONE process-wide callback and gate it
+    themselves.
+
+    Returns True when the listener was installed; False on legacy jax
+    without ``jax.monitoring``, where callers fall back to polling the
+    jit cache via :func:`jit_cache_size`.
+    """
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    register = getattr(monitoring,
+                       "register_event_duration_secs_listener", None)
+    if register is None:
+        return False
+
+    def _on_event(event, duration_secs, **kwargs):
+        del duration_secs, kwargs
+        callback(event)
+
+    register(_on_event)
+    return True
+
+
+def jit_cache_size(fn):
+    """Entries in ``fn``'s jit cache, or -1 when this jax build doesn't
+    expose it. The legacy-jax fallback for counting retraces: a growing
+    cache across steady-state calls IS a retrace, whoever caused it."""
+    probe = getattr(fn, "_cache_size", None)
+    if callable(probe):
+        try:
+            return int(probe())
+        except Exception:
+            return -1
+    return -1

@@ -1,6 +1,6 @@
 """graftlint: static analysis for the JAX hazards this codebase lives with.
 
-Four layers, one entry point (``python -m mercury_tpu.lint``):
+Five layers, one entry point (``python -m mercury_tpu.lint``):
 
 - **Layer 1** (:mod:`mercury_tpu.lint.rules`, :mod:`mercury_tpu.lint.engine`)
   is an AST rule engine over the package's own source with JAX-specific
@@ -54,6 +54,26 @@ Four layers, one entry point (``python -m mercury_tpu.lint``):
   tests, and a :class:`~mercury_tpu.lint.racecheck.ThreadLeakGuard`
   behind the conftest-wide thread-leak fixture. Pure stdlib, like
   Layer 1.
+
+- **Layer P** (:mod:`mercury_tpu.lint.perf`,
+  :mod:`mercury_tpu.lint.tracecheck`) treats the *cost* of the compiled
+  program as a checked artifact: AOT ``cost_analysis()`` FLOPs/bytes
+  attributed to the named scopes (``mercury_scoring``,
+  ``mercury_grad_sync``, ``mercury_augmentation``, ``mercury_optimizer``,
+  ``mercury_input_fuse``) with a per-plan ratchet in the committed
+  ``lint/perf_budgets.json`` golden, a hard scoring-FLOPs-fraction
+  ceiling, and an HLO fusion/precision scan (bf16→f32 upcasts inside the
+  bf16 scoring region, copy/transpose churn in hot scopes, unfused
+  elementwise chains in ``mercury_input_fuse``). The runtime side is a
+  retrace guard: :class:`~mercury_tpu.lint.tracecheck.CompileMonitor`
+  counts jaxpr traces and backend compiles via ``jax.monitoring``,
+  drives each plan's step for N calls, and asserts the steady state
+  compiles nothing (``python -m mercury_tpu.lint.tracecheck``). New AST
+  rules GL130–GL133 ride along in Layer 1 (churned closure captures,
+  shape-dependent branches, NumPy constants built per-trace, unhashable
+  static args). ``--layer perf --regen`` rewrites the golden; a bare
+  ``--regen`` regenerates all four goldens atomically via
+  :mod:`mercury_tpu.lint.golden`.
 
 See ``docs/LINT.md`` for the rule catalog and ``docs/DESIGN.md`` for the
 audit invariants.

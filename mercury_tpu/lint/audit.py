@@ -49,6 +49,8 @@ import sys
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
+from mercury_tpu.lint import golden
+
 SCHEMA = "graftlint_budgets_v1"
 PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "hs_local", "hs_fused", "sp",
               "pp", "async")
@@ -82,7 +84,12 @@ def ensure_cpu_devices(n: int = 8) -> None:
     initializes (same dance as tests/conftest.py)."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
-        if "jax" in sys.modules:
+        # Probe device count ONLY when a backend is already live: calling
+        # jax.devices() on a merely-imported jax would itself initialize
+        # a 1-device backend and make the XLA_FLAGS below a no-op (the
+        # tracecheck CLI hits this — importing compat pulls in jax).
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if xb is not None and getattr(xb, "_backends", None):
             import jax
 
             if len(jax.devices()) >= n:
@@ -563,49 +570,27 @@ def check_invariants(m: PlanMeasurement) -> List[str]:
 # budgets file
 # --------------------------------------------------------------------------
 
-def write_budgets(measurements: Sequence[PlanMeasurement],
-                  path: Optional[str] = None) -> str:
-    import jax
-    import jaxlib
-
-    path = path or default_budgets_path()
-    doc = {
+def budgets_doc(measurements: Sequence[PlanMeasurement]) -> Dict[str, Any]:
+    return {
         "schema": SCHEMA,
-        "provenance": {
-            "jax": jax.__version__,
-            "jaxlib": jaxlib.__version__,
-            "python": ".".join(map(str, sys.version_info[:3])),
-            "regenerate_with":
-                "python -m mercury_tpu.lint --layer audit --regen",
-        },
+        "provenance": golden.provenance(
+            "python -m mercury_tpu.lint --layer audit --regen"),
         "plans": {m.plan: m.as_budget() for m in measurements},
     }
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.write("\n")
-    return path
+
+
+def write_budgets(measurements: Sequence[PlanMeasurement],
+                  path: Optional[str] = None) -> str:
+    return golden.write_golden(path or default_budgets_path(),
+                               budgets_doc(measurements))
 
 
 def load_budgets(path: Optional[str] = None) -> Dict[str, Any]:
-    path = path or default_budgets_path()
-    with open(path) as f:
-        doc = json.load(f)
-    if doc.get("schema") != SCHEMA:
-        raise ValueError(
-            f"{path}: schema {doc.get('schema')!r}, expected {SCHEMA!r} "
-            "— regenerate with --regen")
-    return doc
+    return golden.load_golden(path or default_budgets_path(), SCHEMA,
+                              "--layer audit --regen")
 
 
-def _diff_counts(what: str, expected: Dict[str, int],
-                 got: Dict[str, int]) -> List[str]:
-    lines = []
-    for prim in sorted(set(expected) | set(got)):
-        e, g = expected.get(prim, 0), got.get(prim, 0)
-        if e != g:
-            lines.append(f"  {what}: {prim} expected {e}, got {g} "
-                         f"({g - e:+d})")
-    return lines
+_diff_counts = golden.diff_counts
 
 
 def compare_budgets(measurements: Sequence[PlanMeasurement],
@@ -704,8 +689,6 @@ def run_audit(plans: Sequence[str] = PLAN_NAMES,
     budgets = load_budgets(budgets_path)
     errors, warnings = compare_budgets(measurements, budgets)
     if diff_out and (errors or warnings):
-        with open(diff_out, "w") as f:
-            f.write("\n".join(
-                ["# graftlint audit diff"] + errors +
-                ["# warnings"] + warnings) + "\n")
+        golden.write_diff_file(diff_out, "graftlint audit diff",
+                               errors, warnings)
     return errors, warnings

@@ -19,9 +19,20 @@ Layer selection:
   (GL120–GL125) over the hot thread modules plus thread-manifest parity
   against the committed ``lint/thread_manifest.json`` (``--regen`` to
   re-record after an intentional fleet change). Pure stdlib.
+- ``--layer perf``: Layer P — AOT cost/roofline budgets per named scope
+  plus the fusion/precision HLO scan, verified against the committed
+  ``lint/perf_budgets.json`` (``--regen`` parity; the regen also
+  re-measures the retrace expectations that the runtime guard,
+  ``python -m mercury_tpu.lint.tracecheck``, asserts).
 - ``--layer all``: all of the above. With ``--diff-out PATH`` the audit
-  diff goes to ``PATH``, the sharding diff to ``PATH.sharding``, and
-  the thread-manifest diff to ``PATH.threads``.
+  diff goes to ``PATH``, the sharding diff to ``PATH.sharding``, the
+  thread-manifest diff to ``PATH.threads``, and the perf diff to
+  ``PATH.perf``.
+
+``--regen`` with the default ``--layer ast`` (or ``--layer all``) is the
+one-stop regen: it re-measures EVERY budget layer and rewrites all four
+goldens atomically — either every file updates or none does (a plan that
+fails mid-measure cannot leave a half-regenerated set).
 
 ``--json`` emits one document for every layer that ran::
 
@@ -54,14 +65,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="graftlint: JAX-hazard AST linter (Layer 1) + "
                     "jaxpr/HLO structural auditor (Layer 2) + "
                     "sharding & memory auditor (Layer 3) + "
-                    "host-concurrency auditor (Layer C)",
+                    "host-concurrency auditor (Layer C) + "
+                    "cost/roofline & retrace auditor (Layer P)",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/directories for Layer 1 (default: the "
                          "mercury_tpu package)")
     ap.add_argument("--layer",
                     choices=("ast", "metrics", "audit", "sharding",
-                             "concurrency", "all"),
+                             "concurrency", "perf", "all"),
                     default="ast")
     ap.add_argument("--select", action="append", default=None,
                     metavar="RULE",
@@ -84,6 +96,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--thread-manifest", default=None, metavar="PATH",
                     help="Layer C thread_manifest.json to verify "
                          "against / regenerate")
+    ap.add_argument("--perf-budgets", default=None, metavar="PATH",
+                    help="Layer P perf_budgets.json to verify against "
+                         "/ regenerate")
     ap.add_argument("--regen", action="store_true",
                     help="re-measure and WRITE the budget file(s) instead "
                          "of verifying (review the diff before committing)")
@@ -100,6 +115,40 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rule.id} [{rule.slug}] {rule.summary}")
             print(f"    fix: {rule.hint}")
         return 0
+
+    if args.regen and args.layer in ("ast", "all"):
+        # One-stop atomic regen: re-measure every budget layer, then
+        # commit all four goldens in a single all-or-nothing batch
+        # (lint/golden.py::regen_all_goldens). Any measurement or
+        # invariant failure aborts before a single committed file moves.
+        from mercury_tpu.lint import golden
+        from mercury_tpu.lint import audit as _audit
+
+        plans = None
+        if args.plans:
+            plans = tuple(p.strip() for p in args.plans.split(","))
+            unknown = [p for p in plans if p not in _audit.PLAN_NAMES]
+            if unknown:
+                print(f"unknown plan(s): {', '.join(unknown)} "
+                      f"(known: {', '.join(_audit.PLAN_NAMES)})",
+                      file=sys.stderr)
+                return 2
+        try:
+            errors, warnings = golden.regen_all_goldens(
+                plans=plans,
+                budgets_path=args.budgets,
+                shard_budgets_path=args.shard_budgets,
+                manifest_path=args.thread_manifest,
+                perf_budgets_path=args.perf_budgets)
+        except Exception as exc:  # nothing was committed — say so
+            print(f"graftlint regen: aborted with no golden rewritten "
+                  f"({type(exc).__name__}: {exc})", file=sys.stderr)
+            return 2
+        for line in warnings:
+            print(f"warning: {line}")
+        for line in errors:
+            print(line)
+        return 1 if errors else 0
 
     rc = 0
     json_findings: List[dict] = []
@@ -244,6 +293,37 @@ def main(argv: Optional[List[str]] = None) -> int:
             if not errors:
                 print(f"graftlint sharding: {len(plans)} plan(s) "
                       f"verified ({', '.join(plans)})")
+        if errors:
+            rc = 1
+
+    if args.layer in ("perf", "all"):
+        from mercury_tpu.lint import perf
+
+        plans = _resolve_plans(perf.PLAN_NAMES, "perf")
+        if plans is None:
+            return 2
+        diff_out = args.diff_out
+        if diff_out and args.layer == "all":
+            diff_out = diff_out + ".perf"
+        try:
+            errors, warnings = perf.run_perf_audit(
+                plans=plans, budgets_path=args.perf_budgets,
+                regen=args.regen, diff_out=diff_out)
+        except FileNotFoundError as exc:
+            print(f"graftlint perf: budgets file missing ({exc}) — "
+                  "run with --layer perf --regen first",
+                  file=sys.stderr)
+            return 2
+        if args.as_json:
+            collect("perf", errors, warnings)
+        else:
+            for line in warnings:
+                print(f"warning: {line}")
+            for line in errors:
+                print(line)
+            if not errors:
+                print(f"graftlint perf: {len(plans)} plan(s) verified "
+                      f"({', '.join(plans)})")
         if errors:
             rc = 1
 

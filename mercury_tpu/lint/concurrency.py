@@ -931,10 +931,10 @@ def run_concurrency_check(paths: Optional[Sequence[str]] = None,
         m.path: list(m.findings) for m in models}
 
     if regen:
+        from mercury_tpu.lint import golden
+
         doc = _manifest_doc(models)
-        with open(manifest_path, "w") as f:
-            json.dump(doc, f, indent=2, sort_keys=True)
-            f.write("\n")
+        golden.write_golden(manifest_path, doc)
         warnings.append(
             f"thread manifest written to {manifest_path} "
             f"({len(doc['threads'])} threads, {len(doc['pools'])} "
@@ -956,9 +956,10 @@ def run_concurrency_check(paths: Optional[Sequence[str]] = None,
         for f in m_findings:
             per_module.setdefault(f.path, []).append(f)
         if diff and diff_out:
-            with open(diff_out, "w") as fh:
-                fh.write("\n".join(
-                    ["# graftlint thread-manifest diff"] + diff) + "\n")
+            from mercury_tpu.lint import golden
+
+            golden.write_diff_file(
+                diff_out, "graftlint thread-manifest diff", diff)
 
     all_findings: List[Finding] = []
     for rel, findings in sorted(per_module.items()):

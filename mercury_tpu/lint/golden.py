@@ -1,0 +1,195 @@
+"""Shared golden-file plumbing for graftlint's budget layers.
+
+Layers 2 (``audit.py``), 3 (``sharding.py``), C (``concurrency.py``) and
+P (``perf.py``) all commit a JSON golden next to the lint package and
+verify against it with the same contract: ``--regen`` rewrites the file
+after an intentional change, ``--diff-out`` leaves a CI artifact on
+mismatch, and a schema tag plus provenance header make stale files fail
+loud instead of quietly passing. The first three grew that logic as
+triplicated module tails; this module is the single implementation they
+(and every future layer) share.
+
+Two write paths, one atomicity story:
+
+- :func:`write_golden` — one file, written to ``<path>.tmp`` and
+  ``os.replace``d into place, so a crash mid-serialization never leaves
+  a half-written golden behind.
+- :func:`commit_goldens` — the all-or-nothing multi-file form behind
+  ``python -m mercury_tpu.lint --regen`` (no ``--layer``): every doc is
+  serialized to its tmp file first; only when *all* of them serialized
+  does any ``os.replace`` run. A failure while preparing deletes the
+  tmps and leaves every committed golden exactly as it was.
+
+:func:`regen_all_goldens` is the driver for the latter: it *measures*
+every layer first (the expensive, failure-prone part), then commits all
+four goldens in one batch — so a plan that fails to trace aborts the
+whole regen with nothing rewritten.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def provenance(regen_cmd: str,
+               extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The standard golden-file provenance header: jax/jaxlib/python
+    versions plus the exact command that regenerates the file. Layers
+    append layer-specific knobs (e.g. memory tolerance) via ``extra``."""
+    import jax
+    import jaxlib
+
+    doc: Dict[str, Any] = {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "python": ".".join(map(str, sys.version_info[:3])),
+        "regenerate_with": regen_cmd,
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def _dump(doc: Dict[str, Any]) -> str:
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def write_golden(path: str, doc: Dict[str, Any]) -> str:
+    """Atomically write one golden JSON file (tmp + ``os.replace``)."""
+    blob = _dump(doc)  # serialize BEFORE touching the filesystem
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def commit_goldens(writes: Sequence[Tuple[str, Dict[str, Any]]],
+                   ) -> List[str]:
+    """All-or-nothing multi-golden commit.
+
+    Every ``(path, doc)`` is serialized and staged to ``<path>.tmp``
+    first; only when the whole batch staged cleanly are the tmps
+    ``os.replace``d into place. Any failure during staging removes the
+    tmps and re-raises — no committed golden is touched.
+    """
+    staged: List[Tuple[str, str]] = []
+    try:
+        for path, doc in writes:
+            blob = _dump(doc)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(blob)
+            staged.append((tmp, path))
+    except Exception:
+        for tmp, _ in staged:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        raise
+    for tmp, path in staged:
+        os.replace(tmp, path)
+    return [path for path, _ in writes]
+
+
+def load_golden(path: str, schema: str, regen_hint: str) -> Dict[str, Any]:
+    """Load + schema-check a committed golden. Raises FileNotFoundError
+    when missing (the CLI maps that to exit code 2 with a regen hint)
+    and ValueError on a schema-tag mismatch."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != schema:
+        raise ValueError(
+            f"{path}: schema {doc.get('schema')!r}, expected {schema!r} "
+            f"— regenerate with {regen_hint}")
+    return doc
+
+
+def write_diff_file(path: str, title: str, errors: Sequence[str],
+                    warnings: Optional[Sequence[str]] = None) -> None:
+    """The ``--diff-out`` CI artifact: findings under a ``# title``
+    header, warnings (when given) under ``# warnings``."""
+    lines = [f"# {title}"] + list(errors)
+    if warnings is not None:
+        lines += ["# warnings"] + list(warnings)
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def diff_counts(what: str, expected: Dict[str, int],
+                got: Dict[str, int]) -> List[str]:
+    """Per-key count diff lines, the shared budget-comparison idiom."""
+    lines = []
+    for key in sorted(set(expected) | set(got)):
+        e, g = expected.get(key, 0), got.get(key, 0)
+        if e != g:
+            lines.append(f"  {what}: {key} expected {e}, got {g} "
+                         f"({g - e:+d})")
+    return lines
+
+
+# --------------------------------------------------------------------------
+# atomic all-layer regen
+# --------------------------------------------------------------------------
+
+def regen_all_goldens(plans: Optional[Sequence[str]] = None,
+                      budgets_path: Optional[str] = None,
+                      shard_budgets_path: Optional[str] = None,
+                      manifest_path: Optional[str] = None,
+                      perf_budgets_path: Optional[str] = None,
+                      retrace_steps: int = 4,
+                      ) -> Tuple[List[str], List[str]]:
+    """Re-measure and rewrite EVERY layer's golden in one atomic batch.
+
+    Measurement order is cheap-to-expensive (manifest AST scan, Layer 2
+    traces, Layer 3 compiles, Layer P compiles + retrace execution); a
+    failure anywhere aborts before a single committed file changes.
+    Returns ``(errors, warnings)`` where errors are the layers' hard
+    invariants evaluated on the fresh measurements (a regen must not
+    mask e.g. an f32 scoring leak) and warnings list the written files.
+    """
+    # Lazy layer imports: the layers import this module for their own
+    # golden plumbing, so the dependency must point inward only at call
+    # time.
+    from mercury_tpu.lint import audit, concurrency, perf, sharding
+
+    audit.ensure_cpu_devices()
+    plan_names = tuple(plans) if plans else audit.PLAN_NAMES
+
+    manifest_doc = concurrency.extract_manifest(
+        [os.path.join(concurrency._repo_root(), m)
+         for m in concurrency.HOT_THREAD_MODULES])
+    audit_ms = [audit.measure_plan(p) for p in plan_names]
+    shard_ms = [sharding.measure_shard_plan(p) for p in plan_names]
+    perf_ms = [perf.measure_perf_plan(p) for p in plan_names]
+    retrace_ms = [perf.measure_plan_retraces(p, steps=retrace_steps)
+                  for p in plan_names]
+
+    errors: List[str] = []
+    for m in audit_ms:
+        errors.extend(audit.check_invariants(m))
+    errors.extend(sharding.check_axis_registry())
+    for m in shard_ms:
+        errors.extend(sharding.check_shard_invariants(m))
+    for m in perf_ms:
+        errors.extend(perf.check_perf_invariants(m))
+
+    writes = [
+        (manifest_path or concurrency.default_manifest_path(),
+         manifest_doc),
+        (budgets_path or audit.default_budgets_path(),
+         audit.budgets_doc(audit_ms)),
+        (shard_budgets_path or sharding.default_shard_budgets_path(),
+         sharding.shard_budgets_doc(shard_ms)),
+        (perf_budgets_path or perf.default_perf_budgets_path(),
+         perf.perf_budgets_doc(perf_ms, retrace_ms)),
+    ]
+    written = commit_goldens(writes)
+    warnings = [f"golden written to {p}" for p in written]
+    return errors, warnings

@@ -226,6 +226,49 @@ GL125 = _rule(
     "review the manifest diff, and commit it",
 )
 
+# Layer P retrace-hazard rules (runtime counterpart: the retrace guard
+# in lint/tracecheck.py catches these when they slip through). Static
+# and hot-module scoped, like GL111: the step path is where a silent
+# compile-per-step treadmill costs real money.
+GL130 = _rule(
+    "GL130", "retrace-closure-capture",
+    "traced function closes over a variable its enclosing function "
+    "rebinds (loop target, augmented assignment, repeated assignment): "
+    "the captured python value either bakes stale into the trace or "
+    "re-traces the function on every rebind",
+    "pass the value as an argument (traced, or static if hashable) "
+    "instead of closing over it, or hoist the jit outside the loop "
+    "that rebinds the captured name",
+)
+GL131 = _rule(
+    "GL131", "shape-branch-retrace",
+    "host-level `if`/`while` on a traced argument's shape/len/ndim "
+    "inside a jitted function: every distinct input shape traces and "
+    "compiles its own executable — a shape-churning caller turns one "
+    "program into a compile treadmill",
+    "pad or bucket inputs to a fixed shape before the jit boundary, or "
+    "move the shape branch outside the traced function",
+)
+GL132 = _rule(
+    "GL132", "np-constant-in-trace",
+    "np. constant constructor inside a traced function: a numpy scalar "
+    "or array built per call is strongly typed where a python literal "
+    "stays weak, so the operand dtype (and with it the jit cache key) "
+    "depends on which call site ran — weak-type churn is a retrace",
+    "hoist the constant to module scope, or spell it as a python "
+    "literal / jnp constructor so its type is owned by the trace",
+)
+GL133 = _rule(
+    "GL133", "unhashable-static-arg",
+    "jit static argument fed an unhashable value: a list/dict/set "
+    "literal at the call site (TypeError at best, per-call conversion "
+    "churn at worst) or a mutable default on the wrapped function's "
+    "static parameter",
+    "make static arguments hashable and call-stable: tuples instead of "
+    "lists, frozen structs instead of dicts; hoist per-call conversions "
+    "out of the call expression",
+)
+
 # Mirror of parallel/mesh.py::MESH_AXES. Layer 1 must not import jax (or
 # anything that does), so the set is duplicated here; Layer 3's audit
 # cross-checks the two at every run (lint/sharding.py
@@ -1082,6 +1125,279 @@ def check_worker_sync(an: ModuleAnalysis) -> List[RawFinding]:
     return out
 
 
+def _bound_names(an: ModuleAnalysis, fn: ast.AST) -> Set[str]:
+    """Names bound inside ``fn``'s immediate scope: parameters, assign /
+    aug-assign / for targets, with-as and walrus bindings."""
+    names: Set[str] = set()
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in an.nodes_of_function(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, _FUNC_NODES):
+            names.add(node.name)
+    return names
+
+
+def check_retrace_closure_capture(an: ModuleAnalysis) -> List[RawFinding]:
+    """GL130: traced nested function reads a name its enclosing function
+    churns (rebinds in a loop, aug-assigns, or assigns repeatedly)."""
+    if not _in_hot_module(an.path):
+        return []
+    out: List[RawFinding] = []
+    for fn in sorted(an.traced, key=lambda n: n.lineno):
+        enc = an.enclosing_function(fn)
+        if enc is None or enc in an.traced:
+            continue  # module-level, or a closure inside another trace
+        # Only rebinds that happen AFTER the traced def (or the loop the
+        # def sits inside) churn the capture; straight-line assignments
+        # before it are config normalization, stable by trace time.
+        ancestors: Set[ast.AST] = set()
+        cursor: Optional[ast.AST] = fn
+        while cursor is not None:
+            ancestors.add(cursor)
+            cursor = an.parents.get(cursor)
+        churned: Set[str] = set()
+        assign_counts: Dict[str, int] = {}
+        late_assigns: Set[str] = set()
+        for node in an.nodes_of_function(enc):
+            if isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name):
+                if node.lineno > fn.lineno:
+                    churned.add(node.target.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if node in ancestors or node.lineno > fn.lineno:
+                    for t in ast.walk(node.target):
+                        if isinstance(t, ast.Name):
+                            churned.add(t.id)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name):
+                            assign_counts[nm.id] = \
+                                assign_counts.get(nm.id, 0) + 1
+                            if nm.lineno > fn.lineno:
+                                late_assigns.add(nm.id)
+        churned |= {n for n in late_assigns
+                    if assign_counts.get(n, 0) >= 2}
+        if not churned:
+            continue
+        local = _bound_names(an, fn)
+        reported: Set[str] = set()
+        for node in an.nodes_of_function(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load) and node.id in churned \
+                    and node.id not in local \
+                    and node.id not in reported:
+                reported.add(node.id)
+                out.append(RawFinding(
+                    GL130, node.lineno, node.col_offset,
+                    f"traced function '{fn.name}' closes over "
+                    f"'{node.id}', which '{enc.name}' rebinds — the "
+                    "captured value bakes stale into the trace or "
+                    "re-traces on every rebind"))
+    return out
+
+
+def check_shape_branch_retrace(an: ModuleAnalysis) -> List[RawFinding]:
+    """GL131: if/while test probes a traced parameter's shape."""
+    if not _in_hot_module(an.path):
+        return []
+    out: List[RawFinding] = []
+    for fn in sorted(an.traced, key=lambda n: n.lineno):
+        params: Set[str] = set()
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            params.add(a.arg)
+        for node in an.nodes_of_function(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if isinstance(node, ast.If) and node.body and not node.orelse \
+                    and all(isinstance(s, ast.Raise) for s in node.body):
+                # `if x.shape...: raise` is static shape *validation* —
+                # a one-shot trace-time guard, not a per-shape branch
+                continue
+            probe = _shape_probe(node.test, params)
+            if probe:
+                out.append(RawFinding(
+                    GL131, node.test.lineno, node.test.col_offset,
+                    f"traced function '{fn.name}' branches on "
+                    f"`{probe}` — each distinct input shape compiles "
+                    "its own executable"))
+    return out
+
+
+def _shape_probe(test: ast.AST, params: Set[str]) -> Optional[str]:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) \
+                and sub.attr in ("shape", "ndim", "size"):
+            dotted = _dotted(sub)
+            if dotted and dotted.split(".")[0] in params:
+                return dotted
+        elif isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Name) and sub.func.id == "len" \
+                and sub.args and isinstance(sub.args[0], ast.Name) \
+                and sub.args[0].id in params:
+            return f"len({sub.args[0].id})"
+    return None
+
+
+#: np constructors whose *literal-argument* use inside a trace builds a
+#: fresh strongly-typed constant per call (GL132). Converting a traced
+#: value with np.asarray is GL102's host-sync territory, not this.
+_NP_CONST_CTORS = {
+    "array", "asarray", "ones", "zeros", "full", "arange", "eye",
+    "linspace", "float32", "float64", "float16", "int8", "int16",
+    "int32", "int64", "uint8", "uint16", "uint32", "uint64", "bool_",
+}
+
+
+def _literal_only(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Call, ast.Attribute)):
+            return False
+    return True
+
+
+def check_np_constant_in_trace(an: ModuleAnalysis) -> List[RawFinding]:
+    """GL132: per-call np constant built inside a traced function."""
+    if not _in_hot_module(an.path):
+        return []
+    out: List[RawFinding] = []
+    for fn in sorted(an.traced, key=lambda n: n.lineno):
+        for node in an.nodes_of_function(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if not dotted or "." not in dotted:
+                continue
+            base, last = dotted.split(".")[0], dotted.split(".")[-1]
+            if base not in an.np_aliases \
+                    or last not in _NP_CONST_CTORS:
+                continue
+            if node.args and not all(_literal_only(a)
+                                     for a in node.args):
+                continue  # converting a value: GL102's territory
+            out.append(RawFinding(
+                GL132, node.lineno, node.col_offset,
+                f"`{dotted}(...)` builds a strongly-typed numpy "
+                f"constant per call inside traced function "
+                f"'{fn.name}' — weak-type churn against python "
+                "literals re-traces; hoist it to module scope"))
+    return out
+
+
+def _static_slots(call: ast.Call) -> Tuple[List[int], List[str]]:
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(
+                        v.value, int):
+                    nums.append(v.value)
+        elif kw.arg == "static_argnames":
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(
+                        v.value, str):
+                    names.append(v.value)
+    return nums, names
+
+
+def check_unhashable_static_arg(an: ModuleAnalysis) -> List[RawFinding]:
+    """GL133: mutable defaults on static parameters, and call sites
+    passing unhashable literals at static positions."""
+    if not _in_hot_module(an.path):
+        return []
+    out: List[RawFinding] = []
+    defs_by_name: Dict[str, ast.AST] = {}
+    for f in an.functions():
+        defs_by_name.setdefault(f.name, f)
+
+    def flag_mutable_defaults(fn: ast.AST, nums: List[int],
+                              names: List[str], where: ast.AST) -> None:
+        args = list(fn.args.posonlyargs) + list(fn.args.args)
+        defaults = fn.args.defaults
+        offset = len(args) - len(defaults)
+        for i, default in enumerate(defaults):
+            pos = offset + i
+            if pos >= len(args):
+                continue
+            pname = args[pos].arg
+            if (pos in nums or pname in names) \
+                    and _is_mutable_ctor(default):
+                out.append(RawFinding(
+                    GL133, where.lineno, where.col_offset,
+                    f"static parameter '{pname}' of '{fn.name}' has a "
+                    "mutable default — jit static arguments must be "
+                    "hashable"))
+
+    jitted_calls: Dict[str, Tuple[List[int], List[str]]] = {}
+    for node in ast.walk(an.tree):
+        if not isinstance(node, ast.Call) \
+                or _last_attr(node.func) not in ("jit", "pjit"):
+            continue
+        nums, names = _static_slots(node)
+        if not nums and not names:
+            continue
+        if node.args and isinstance(node.args[0], ast.Name):
+            wrapped = defs_by_name.get(node.args[0].id)
+            if wrapped is not None:
+                flag_mutable_defaults(wrapped, nums, names, node)
+            parent = an.parents.get(node)
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        jitted_calls[t.id] = (nums, names)
+
+    # decorator form: @partial(jax.jit, static_argnums=...)
+    for fn in an.functions():
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and _last_attr(
+                    dec.func) == "partial" and dec.args \
+                    and _last_attr(dec.args[0]) in ("jit", "pjit"):
+                nums, names = _static_slots(dec)
+                if nums or names:
+                    flag_mutable_defaults(fn, nums, names, dec)
+                    jitted_calls[fn.name] = (nums, names)
+
+    for node in ast.walk(an.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Name) \
+                or node.func.id not in jitted_calls:
+            continue
+        nums, names = jitted_calls[node.func.id]
+        for i, arg in enumerate(node.args):
+            if i in nums and _is_mutable_ctor(arg):
+                out.append(RawFinding(
+                    GL133, arg.lineno, arg.col_offset,
+                    f"unhashable literal at static position {i} of "
+                    f"jitted '{node.func.id}' — jit raises on it, and "
+                    "a per-call conversion would re-trace every call"))
+        for kw in node.keywords:
+            if kw.arg in names and _is_mutable_ctor(kw.value):
+                out.append(RawFinding(
+                    GL133, kw.value.lineno, kw.value.col_offset,
+                    f"unhashable literal for static argument "
+                    f"'{kw.arg}' of jitted '{node.func.id}' — jit "
+                    "static arguments must be hashable"))
+    return out
+
+
 _CHECKS = (
     check_key_reuse,
     check_host_sync,
@@ -1096,6 +1412,10 @@ _CHECKS = (
     check_manual_all_gather,
     check_unknown_mesh_axis,
     check_worker_sync,
+    check_retrace_closure_capture,
+    check_shape_branch_retrace,
+    check_np_constant_in_trace,
+    check_unhashable_static_arg,
 )
 
 

@@ -46,10 +46,10 @@ import hashlib
 import json
 import os
 import re
-import sys
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from mercury_tpu.lint import golden
 from mercury_tpu.lint import memory as lint_memory
 from mercury_tpu.lint.audit import (
     COLLECTIVE_PRIMS,
@@ -291,50 +291,29 @@ def check_axis_registry() -> List[str]:
 # budgets file
 # --------------------------------------------------------------------------
 
-def write_shard_budgets(measurements: Sequence[ShardMeasurement],
-                        path: Optional[str] = None) -> str:
-    import jax
-    import jaxlib
-
-    path = path or default_shard_budgets_path()
-    doc = {
+def shard_budgets_doc(measurements: Sequence[ShardMeasurement],
+                      ) -> Dict[str, Any]:
+    return {
         "schema": SCHEMA,
-        "provenance": {
-            "jax": jax.__version__,
-            "jaxlib": jaxlib.__version__,
-            "python": ".".join(map(str, sys.version_info[:3])),
-            "memory_tolerance": lint_memory.DEFAULT_TOLERANCE,
-            "regenerate_with":
-                "python -m mercury_tpu.lint --layer sharding --regen",
-        },
+        "provenance": golden.provenance(
+            "python -m mercury_tpu.lint --layer sharding --regen",
+            extra={"memory_tolerance": lint_memory.DEFAULT_TOLERANCE}),
         "plans": {m.plan: m.as_budget() for m in measurements},
     }
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.write("\n")
-    return path
+
+
+def write_shard_budgets(measurements: Sequence[ShardMeasurement],
+                        path: Optional[str] = None) -> str:
+    return golden.write_golden(path or default_shard_budgets_path(),
+                               shard_budgets_doc(measurements))
 
 
 def load_shard_budgets(path: Optional[str] = None) -> Dict[str, Any]:
-    path = path or default_shard_budgets_path()
-    with open(path) as f:
-        doc = json.load(f)
-    if doc.get("schema") != SCHEMA:
-        raise ValueError(
-            f"{path}: schema {doc.get('schema')!r}, expected {SCHEMA!r} "
-            "— regenerate with --layer sharding --regen")
-    return doc
+    return golden.load_golden(path or default_shard_budgets_path(),
+                              SCHEMA, "--layer sharding --regen")
 
 
-def _diff_counts(what: str, expected: Dict[str, int],
-                 got: Dict[str, int]) -> List[str]:
-    lines = []
-    for key in sorted(set(expected) | set(got)):
-        e, g = expected.get(key, 0), got.get(key, 0)
-        if e != g:
-            lines.append(f"  {what}: {key} expected {e}, got {g} "
-                         f"({g - e:+d})")
-    return lines
+_diff_counts = golden.diff_counts
 
 
 def compare_shard_budgets(measurements: Sequence[ShardMeasurement],
@@ -438,8 +417,6 @@ def run_sharding_audit(plans: Sequence[str] = PLAN_NAMES,
     cmp_errors, warnings = compare_shard_budgets(measurements, budgets)
     errors.extend(cmp_errors)
     if diff_out and (errors or warnings):
-        with open(diff_out, "w") as f:
-            f.write("\n".join(
-                ["# graftlint sharding diff"] + errors +
-                ["# warnings"] + warnings) + "\n")
+        golden.write_diff_file(diff_out, "graftlint sharding diff",
+                               errors, warnings)
     return errors, warnings

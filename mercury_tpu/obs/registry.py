@@ -95,6 +95,10 @@ METRIC_KEYS: Dict[str, str] = {
     "threads/queue_depth/metrics": "async metric records pending drain",
     "threads/queue_depth/prefetch": "committed prefetch batches pending",
     "threads/queue_depth/scorer": "scored chunks pending application",
+    # lint/* — runtime retrace guard (lint/tracecheck.py), emitted at the
+    # log gate only while Trainer.arm_retrace_guard() has a monitor armed
+    "lint/retrace_events": "jaxpr traces observed since the last log tick",
+    "lint/compile_count": "XLA backend compiles observed since the last tick",
 }
 
 #: Bookkeeping fields that ride along in every record but are not metric

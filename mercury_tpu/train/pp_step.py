@@ -174,12 +174,13 @@ def make_pp_mercury_step(
         (loss, (logits, moe_aux)), grads = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True
         )(state.stacked, state.rest)
-        updates, opt_state = tx.update(
-            grads, state.opt_state, (state.stacked, state.rest)
-        )
-        stacked, rest = optax.apply_updates(
-            (state.stacked, state.rest), updates
-        )
+        with jax.named_scope("mercury_optimizer"):
+            updates, opt_state = tx.update(
+                grads, state.opt_state, (state.stacked, state.rest)
+            )
+            stacked, rest = optax.apply_updates(
+                (state.stacked, state.rest), updates
+            )
         acc = jnp.mean(
             (jnp.argmax(logits, -1) == pool_y[sel.selected]).astype(
                 jnp.float32

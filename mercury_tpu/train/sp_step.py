@@ -288,8 +288,10 @@ def make_dp_sp_mercury_step(
                 grads,
             )
         loss = lax.pmean(loss, data_axis)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        with jax.named_scope("mercury_optimizer"):
+            updates, opt_state = tx.update(
+                grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
         new_state = SpMercuryState(
             params=params,
             opt_state=opt_state,

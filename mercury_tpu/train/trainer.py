@@ -662,6 +662,10 @@ class Trainer:
         # fit loop). Built BEFORE auto_resume: a restore resets the fleet
         # via _recommit_state (queued chunks scored the old trajectory).
         self._scorer_fleet = None
+        # Runtime retrace guard (graftlint Layer P): armed explicitly via
+        # arm_retrace_guard(); when live, the log gate emits
+        # lint/retrace_events + lint/compile_count per tick.
+        self._retrace_monitor = None
         if (config.use_importance_sampling
                 and config.sampler == "scoretable"
                 and config.refresh_mode == "async"):
@@ -918,6 +922,24 @@ class Trainer:
             ctx["scorer_fleet"] = fleet.summary()
         return ctx
 
+    def arm_retrace_guard(self):
+        """Arm the Layer P runtime retrace guard for this trainer.
+
+        Installs a :class:`mercury_tpu.lint.tracecheck.CompileMonitor`
+        whose per-tick deltas the log gate emits as
+        ``lint/retrace_events`` / ``lint/compile_count``. In steady state
+        both should be 0 every tick; a nonzero reading names a step that
+        re-entered the compiler (the offline guard,
+        ``python -m mercury_tpu.lint.tracecheck``, then attributes it).
+        Idempotent; returns the monitor so tests can snapshot it."""
+        if self._retrace_monitor is None:
+            from mercury_tpu.lint.tracecheck import CompileMonitor
+
+            self._retrace_monitor = CompileMonitor()
+            self._retrace_monitor.start()
+            self._retrace_last = (0, 0)
+        return self._retrace_monitor
+
     # ------------------------------------------------------------------ fit
     def fit(self, num_epochs: Optional[int] = None) -> Dict[str, float]:
         """Run training (``Trainer.fit``, ``pytorch_collab.py:56-72``).
@@ -1043,6 +1065,19 @@ class Trainer:
                         record.update(host_thread_stats())
                         record["threads/queue_depth/metrics"] = float(
                             self.logger.queue_depth())
+                        if self._retrace_monitor is not None:
+                            # Retrace guard armed: per-tick deltas of the
+                            # process-wide trace/compile event counters.
+                            # Steady state is 0/0 — anything else means a
+                            # step re-entered the compiler this interval.
+                            traces, compiles = \
+                                self._retrace_monitor.snapshot()
+                            lt, lc = self._retrace_last
+                            record["lint/retrace_events"] = float(
+                                traces - lt)
+                            record["lint/compile_count"] = float(
+                                compiles - lc)
+                            self._retrace_last = (traces, compiles)
                         record["epoch"] = (step - 1) // self.steps_per_epoch
                         if self._crosshost_gather is not None:
                             # allgather mode: EVERY process participates
@@ -1182,6 +1217,9 @@ class Trainer:
         fleet = getattr(self, "_scorer_fleet", None)
         if fleet is not None:
             fleet.close()
+        monitor = getattr(self, "_retrace_monitor", None)
+        if monitor is not None:
+            monitor.stop()
         if getattr(self, "_stream_pipe", None) is not None:
             self._stream_pipe.close()
         if getattr(self, "_profiling", False):
