@@ -364,6 +364,48 @@ class TrainConfig:
     # fetch stays synchronous; serialization/IO overlap training).
     # Single-process only; multi-controller saves stay synchronous.
     async_checkpoint: bool = False
+    # Keep the newest N checkpoint generations, pruning older ones after
+    # each successful save; 0 keeps everything (seed behavior).
+    checkpoint_keep: int = 3
+    # Retry transient checkpoint-write OSErrors this many times with
+    # exponential backoff before surfacing the failure; every failed
+    # attempt counts into checkpoint/write_failures.
+    checkpoint_write_retries: int = 2
+    checkpoint_retry_backoff_s: float = 0.25
+    # Write a sha256 manifest sidecar (whole-file + per-leaf digests)
+    # next to each cadence checkpoint, and verify it on restore; a
+    # checkpoint failing verification falls back to the next-older one
+    # exactly like a torn file. Forces the msgpack backend for cadence
+    # saves (the manifest describes those bytes).
+    checkpoint_manifest: bool = True
+    checkpoint_verify: bool = True
+
+    # Fault injection + supervision -----------------------------------------
+    # Deterministic fault schedule (mercury_tpu/faults.py grammar), e.g.
+    # "scorer_die@step=40;ckpt_io_error@step=100,every=50". "" disables —
+    # the hook sites are plain attribute checks and the traced program is
+    # byte-identical (Layer-2/3 digest-enforced).
+    fault_spec: str = ""
+    # Host supervisor (runtime/supervisor.py): watch worker liveness on
+    # the fit loop's cadence, restart dead scorer fleets / prefetch
+    # pipelines with exponential backoff under a restart budget, and on
+    # exhaustion walk the degradation ladder async → sync → frozen →
+    # uniform instead of crashing the run.
+    supervise: bool = False
+    # Restarts allowed per supervised unit before it is declared
+    # exhausted (budget resets when the ladder fully recovers to async).
+    supervisor_restart_budget: int = 3
+    supervisor_backoff_s: float = 0.5   # base of the exponential backoff
+    # Probe cadence (steps) for climbing back up the degradation ladder;
+    # 0 disables probing (a degraded run stays degraded).
+    supervisor_probe_every: int = 200
+    # Optional wall-clock liveness poll thread (seconds between polls);
+    # 0 = step-cadence checks only (no extra thread — the tier-1
+    # default, and sufficient while the trainer thread is healthy).
+    supervisor_poll_s: float = 0.0
+    # Degraded level 1 ("sync"): trainer-thread score refresh every K
+    # steps (the async fleet is dead; K amortizes the on-thread forward).
+    supervisor_sync_every: int = 16
     # Restore the latest checkpoint in checkpoint_dir (if any) at Trainer
     # construction — crash/preemption recovery without a separate restore
     # call. The sampler state is in the checkpoint, so the resumed

@@ -34,10 +34,12 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import traceback
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from mercury_tpu.faults import InjectedFault
 from mercury_tpu.utils.logging import get_logger
 
 _log = get_logger("mercury_tpu.data.stream")
@@ -188,7 +190,8 @@ class PrefetchPipeline:
 
     def __init__(self, source, batch_shape: Tuple[int, int], sharding,
                  depth: int = 2, pop_timeout_s: float = 300.0,
-                 tracer=None, local_workers=None) -> None:
+                 tracer=None, local_workers=None, faults=None,
+                 generation: int = 0) -> None:
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if tracer is None:
@@ -235,6 +238,10 @@ class PrefetchPipeline:
         self._work: "queue.Queue[Any]" = queue.Queue()
         self._ready: "queue.Queue[Any]" = queue.Queue(maxsize=self.depth)
         self._exc: Optional[BaseException] = None
+        self._exc_tb: Optional[str] = None
+        # Fault-injection plane (mercury_tpu/faults.py); None when
+        # disabled — the worker's hook sites are plain attribute checks.
+        self._faults = faults
         self.total_stall_s = 0.0
         self.total_wait_s = 0.0
         self.total_h2d_bytes = 0
@@ -242,8 +249,14 @@ class PrefetchPipeline:
         self._last_stall_s = 0.0
         self._last_h2d_bytes = 0
         self._closed = False
+        # Supervisor restarts build a REPLACEMENT pipeline; the -rN name
+        # suffix keeps respawns distinguishable from leaks in the Layer C
+        # thread census.
+        self.generation = int(generation)
+        suffix = f"-r{self.generation}" if self.generation else ""
         self._thread = threading.Thread(
-            target=self._prefetch_loop, name="mercury-prefetch", daemon=True
+            target=self._prefetch_loop, name=f"mercury-prefetch{suffix}",
+            daemon=True,
         )
         self._thread.start()
 
@@ -268,14 +281,21 @@ class PrefetchPipeline:
         host-side publish lag (gather + H2D dispatch after the index
         materialized), clipped to the time actually waited — the number
         that must stay near zero for the overlap claim to hold."""
+        # Fail FAST and attributably: the worker publishes a poisoned
+        # item (_FAILED) on death, but up to ``depth`` committed batches
+        # can sit ahead of it in the ready queue — checking the failure
+        # latch first surfaces the death (with the worker's traceback)
+        # within one step instead of ``depth`` steps or a pop timeout
+        # later. The supervisor's restart path relies on this promptness
+        # to rebuild the pipeline before the selection ring drifts.
+        if self._exc is not None:
+            raise self._worker_death()
         t0 = time.monotonic()
         try:
             item = self._ready.get(timeout=self._pop_timeout_s)
         except queue.Empty:
             if self._exc is not None:
-                raise RuntimeError(
-                    "prefetch worker died"
-                ) from self._exc
+                raise self._worker_death()
             raise TimeoutError(
                 f"no prefetched batch within {self._pop_timeout_s:.0f}s "
                 "(did the driver forget to push()?)"
@@ -284,10 +304,25 @@ class PrefetchPipeline:
         self.total_wait_s += waited
         self.pops += 1
         if item is _FAILED:
-            raise RuntimeError("prefetch worker died") from self._exc
+            raise self._worker_death()
         batch, host_lag_s = item
         self.total_stall_s += min(waited, host_lag_s)
         return batch
+
+    def _worker_death(self) -> RuntimeError:
+        """The attributable death error: the worker's own traceback rides
+        in the message (the exception context alone loses it — the worker
+        thread's stack is gone by the time pop() re-raises here)."""
+        err = RuntimeError(
+            "prefetch worker died:\n" + (self._exc_tb or "<no traceback>"))
+        err.__cause__ = self._exc
+        return err
+
+    def alive(self) -> bool:
+        """Liveness for the supervisor: open, worker thread running, no
+        failure latched. Lock-free reads of published flags."""
+        return (not self._closed and self._exc is None
+                and self._thread.is_alive())
 
     def stats(self) -> Dict[str, float]:
         """Interval telemetry since the previous call (the
@@ -422,6 +457,13 @@ class PrefetchPipeline:
             if idx is _STOP:
                 return
             try:
+                if self._faults is not None:
+                    if self._faults.fire("prefetch_die") is not None:
+                        raise InjectedFault(
+                            "prefetch_die: injected prefetch-worker death")
+                    stall = self._faults.fire("prefetch_stall")
+                    if stall is not None:
+                        time.sleep(float(stall.get("secs", 1.0)))
                 slot = self._slot
                 self._slot = (slot + 1) % len(self._staging)
                 staging = self._staging[slot]
@@ -462,6 +504,7 @@ class PrefetchPipeline:
                 # host lag rides along for pop()'s stall attribution.
                 self._publish((batch, time.monotonic() - t_ready))
             except BaseException as exc:  # surfaced on the next pop()
+                self._exc_tb = traceback.format_exc()
                 self._exc = exc
                 self._publish(_FAILED)
                 return

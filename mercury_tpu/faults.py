@@ -1,0 +1,213 @@
+"""Deterministic fault-injection plane for the host runtime.
+
+Mercury's premise is training on flaky fleets, so the failure paths —
+a scorer worker dying, a prefetch gather raising, a checkpoint write
+hitting a full disk — are product surface, not test scaffolding. This
+module makes every one of them injectable on a deterministic schedule
+so the supervisor's restart/degradation machinery
+(``runtime/supervisor.py``) is exercised end-to-end in tier-1 tests and
+the chaos CI job, with the SAME hook points production code runs.
+
+Spec grammar (``TrainConfig.fault_spec``)::
+
+    spec  := entry (';' entry)*
+    entry := kind '@' param (',' param)*
+    param := key '=' number
+
+    "scorer_die@step=40"                     # one-shot at step 40
+    "prefetch_stall@step=10,secs=2"          # stall the gather 2s once
+    "ckpt_io_error@step=0,every=1"           # EVERY checkpoint write fails
+    "scorer_die@step=5;scorer_die@step=9"    # two scheduled deaths
+
+``step`` is mandatory: the entry arms at the first trainer step >= it
+(:meth:`FaultPlane.note_step` advances the clock from the fit loop; the
+worker threads only *read* it, so firing is deterministic in step space
+even though workers run asynchronously). ``every=K`` repeats the entry
+each K steps after it first fires; omitted means one-shot. Remaining
+``key=value`` pairs ride along to the hook site (e.g. ``secs`` for
+stalls/slowdowns).
+
+Fault kinds and their hook points:
+
+==================  =====================================================
+``scorer_die``      ``ScorerFleet._next_chunk`` raises — kills the worker
+                    thread that called it (or the trainer-thread sync
+                    refresh, when the ladder has degraded that far)
+``scorer_nan``      ``ScorerFleet._next_chunk`` corrupts the chunk's
+                    scores to NaN (the trainer's apply guard rejects it)
+``prefetch_die``    ``PrefetchPipeline._prefetch_loop`` raises
+``prefetch_stall``  the prefetch worker sleeps ``secs`` before gathering
+``sink_wedge``      the metric drain thread sleeps ``secs`` mid-emit
+``ckpt_io_error``   ``checkpoint._write_msgpack`` raises ``OSError``
+                    before touching the file
+``host_slow``       the fit loop sleeps ``secs`` on the trainer thread
+==================  =====================================================
+
+Zero-cost-when-disabled: every hook site is guarded by
+``if faults is not None`` on a plain attribute, and no hook touches a
+traced function — with ``fault_spec=""`` the compiled step program is
+byte-identical (the graftlint Layer-2/3 digests enforce this).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["FaultPlane", "InjectedFault", "KNOWN_KINDS", "parse_fault_spec"]
+
+#: Every injectable fault kind; a spec naming anything else is rejected
+#: at parse time (a typo'd kind would otherwise never fire, silently).
+KNOWN_KINDS = frozenset({
+    "scorer_die",
+    "scorer_nan",
+    "prefetch_die",
+    "prefetch_stall",
+    "sink_wedge",
+    "ckpt_io_error",
+    "host_slow",
+})
+
+
+class InjectedFault(RuntimeError):
+    """An injected failure — distinguishable from organic errors in
+    logs and flight records, handled identically by the runtime (the
+    whole point: the recovery machinery can't tell the difference)."""
+
+
+class _Entry:
+    """One scheduled fault instance (mutable firing state)."""
+
+    __slots__ = ("kind", "step", "every", "args", "fired", "next_due")
+
+    def __init__(self, kind: str, step: int, every: int,
+                 args: Dict[str, float]) -> None:
+        self.kind = kind
+        self.step = step
+        self.every = every            # 0 = one-shot
+        self.args = args              # extra params for the hook site
+        self.fired = 0
+        self.next_due = step
+
+    def pending(self) -> bool:
+        return self.every > 0 or self.fired == 0
+
+    def spec(self) -> Dict[str, float]:
+        out = {"step": float(self.step), **self.args}
+        if self.every:
+            out["every"] = float(self.every)
+        return out
+
+
+def parse_fault_spec(spec: str) -> List[_Entry]:
+    """Parse the ``kind@k=v,...;kind@...`` grammar; raises ``ValueError``
+    with the offending fragment on any malformed entry."""
+    entries: List[_Entry] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if "@" not in raw:
+            raise ValueError(
+                f"fault_spec entry {raw!r}: expected 'kind@step=N[,k=v...]'")
+        kind, _, params = raw.partition("@")
+        kind = kind.strip()
+        if kind not in KNOWN_KINDS:
+            raise ValueError(
+                f"fault_spec entry {raw!r}: unknown fault kind {kind!r} "
+                f"(known: {', '.join(sorted(KNOWN_KINDS))})")
+        args: Dict[str, float] = {}
+        for pair in params.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=" not in pair:
+                raise ValueError(
+                    f"fault_spec entry {raw!r}: malformed param {pair!r} "
+                    "(expected key=number)")
+            key, _, val = pair.partition("=")
+            try:
+                args[key.strip()] = float(val)
+            except ValueError:
+                raise ValueError(
+                    f"fault_spec entry {raw!r}: param {pair!r} is not "
+                    "numeric") from None
+        if "step" not in args:
+            raise ValueError(
+                f"fault_spec entry {raw!r}: missing the mandatory "
+                "'step=N' param")
+        step = int(args.pop("step"))
+        every = int(args.pop("every", 0))
+        entries.append(_Entry(kind, step, every, args))
+    return entries
+
+
+class FaultPlane:
+    """The armed schedule plus the step clock the hook sites fire
+    against.
+
+    Thread model: :meth:`note_step` is called once per fit-loop
+    iteration on the trainer thread; :meth:`fire` is called from the
+    trainer thread AND from worker threads (scorer fleet, prefetch
+    pipeline, metric drain). All firing state is guarded by one lock —
+    a fault scheduled once fires exactly once, no matter how many
+    workers race on it.
+    """
+
+    def __init__(self, spec: str = "") -> None:
+        self._entries = parse_fault_spec(spec)
+        self._lock = threading.Lock()
+        self._step = 0
+        self._fired_total = 0
+
+    # --------------------------------------------------------------- clock
+    def note_step(self, step: int) -> None:
+        """Advance the plane's step clock (trainer thread, per
+        iteration). Workers read it through :meth:`fire`."""
+        with self._lock:
+            self._step = int(step)
+
+    # -------------------------------------------------------------- firing
+    def fire(self, kind: str) -> Optional[Dict[str, float]]:
+        """Consume the next due entry of ``kind`` at the current step.
+
+        Returns the entry's extra args (possibly empty — still truthy
+        ``is not None``) when a scheduled instance is due, else None.
+        One-shot entries fire once; ``every=K`` entries re-arm K steps
+        after each firing."""
+        with self._lock:
+            step = self._step
+            for entry in self._entries:
+                if entry.kind != kind or not entry.pending():
+                    continue
+                if step < entry.next_due:
+                    continue
+                entry.fired += 1
+                if entry.every:
+                    entry.next_due = step + entry.every
+                self._fired_total += 1
+                return dict(entry.args)
+        return None
+
+    # ----------------------------------------------------------- telemetry
+    def stats(self) -> Dict[str, float]:
+        """Log-gate scalars (host floats; keys in obs/registry.py)."""
+        with self._lock:
+            armed = sum(1 for e in self._entries if e.pending())
+            return {
+                "fault/injected": float(self._fired_total),
+                "fault/armed": float(armed),
+            }
+
+    def summary(self) -> Dict[str, object]:
+        """Cumulative view for flight-record context dumps."""
+        with self._lock:
+            return {
+                "step": self._step,
+                "fired_total": self._fired_total,
+                "entries": [
+                    {"kind": e.kind, "fired": e.fired,
+                     "pending": e.pending(), **e.spec()}
+                    for e in self._entries
+                ],
+            }

@@ -79,9 +79,11 @@ THREAD_MANIFEST_SCHEMA = "graftlint_thread_manifest_v1"
 #: The hot host modules whose thread fleet this layer audits by default.
 HOT_THREAD_MODULES = (
     "mercury_tpu/data/stream.py",
+    "mercury_tpu/faults.py",
     "mercury_tpu/obs/writer.py",
     "mercury_tpu/obs/aggregate.py",
     "mercury_tpu/obs/anomaly.py",
+    "mercury_tpu/runtime/supervisor.py",
     "mercury_tpu/sampling/scorer_fleet.py",
     "mercury_tpu/train/checkpoint.py",
     "mercury_tpu/train/trainer.py",

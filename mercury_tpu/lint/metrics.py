@@ -34,7 +34,7 @@ from typing import Dict, List, Set, Tuple
 #: key and checked against the registry.
 KEY_RE = re.compile(
     r"^(train|test|sampler|perf|time|data|obs|anomaly|host|prof|scorer"
-    r"|threads|lint)"
+    r"|threads|lint|fault|supervisor|checkpoint)"
     r"/[a-z0-9_]+(/[a-z0-9_]+)?$")
 
 #: Backticked tokens in the docs, brace families included

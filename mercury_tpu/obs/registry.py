@@ -43,6 +43,11 @@ METRIC_KEYS: Dict[str, str] = {
         "async refresh: oldest applied-chunk age (steps) since last tick",
     "sampler/refresh_lag_chunks":
         "async refresh: scored chunks queued but not yet applied",
+    "sampler/chunks_rejected":
+        "cumulative non-finite score chunks rejected by the apply guard",
+    "sampler/is_active":
+        "1 while importance sampling drives the draw; 0 once degraded "
+        "to uniform (supervisor ladder level 3)",
     # perf/* — throughput accounting between log ticks
     "perf/steps_per_s": "steps per second since the previous log tick",
     "perf/examples_per_s": "examples per second since the previous log tick",
@@ -99,6 +104,21 @@ METRIC_KEYS: Dict[str, str] = {
     # log gate only while Trainer.arm_retrace_guard() has a monitor armed
     "lint/retrace_events": "jaxpr traces observed since the last log tick",
     "lint/compile_count": "XLA backend compiles observed since the last tick",
+    # fault/* — deterministic fault-injection plane (faults.py), emitted
+    # at the log gate only when config.fault_spec is non-empty
+    "fault/injected": "cumulative faults fired by the injection plane",
+    "fault/armed": "fault schedule entries still pending (not yet fired)",
+    # supervisor/* — host supervisor (runtime/supervisor.py), emitted at
+    # the log gate only when config.supervise is on
+    "supervisor/level":
+        "degradation ladder level: 0 async, 1 sync, 2 frozen, 3 uniform",
+    "supervisor/restarts": "cumulative successful unit restarts",
+    "supervisor/degradations": "cumulative one-level ladder descents",
+    "supervisor/recoveries": "cumulative one-level ladder ascents",
+    "supervisor/units_down": "registered units currently failing liveness",
+    # checkpoint/* — durable checkpoint writer (train/checkpoint.py)
+    "checkpoint/write_failures":
+        "cumulative failed checkpoint write attempts (retries included)",
 }
 
 #: Bookkeeping fields that ride along in every record but are not metric

@@ -79,10 +79,15 @@ class AsyncMetricWriter:
     """
 
     def __init__(self, sinks: Iterable, capacity: int = 256,
-                 start: bool = True, observers: Iterable = ()) -> None:
+                 start: bool = True, observers: Iterable = (),
+                 faults=None) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.sinks = [s for s in sinks if s is not None]
+        # Fault-injection plane (mercury_tpu/faults.py): sink_wedge
+        # stalls the drain thread mid-emit, exercising the drop-oldest
+        # backpressure policy. None when disabled.
+        self._faults = faults
         # Copy-on-write: add_observer() swaps in a new list under _lock
         # and _emit() snapshots it, so registration never races the
         # drain thread mid-iteration.
@@ -209,6 +214,12 @@ class AsyncMetricWriter:
 
     def _emit(self, item) -> None:
         step, t, scalars = item
+        if self._faults is not None:
+            wedge = self._faults.fire("sink_wedge")
+            if wedge is not None:
+                # Wedge the DRAIN thread, not a sink: upstream writes keep
+                # enqueueing and the drop-oldest policy absorbs the stall.
+                time.sleep(float(wedge.get("secs", 1.0)))
         # Snapshot cross-thread state under the lock: `dropped` is
         # incremented by the trainer in write(), `observers` is swapped
         # by add_observer(); the copies are ours for the whole fan-out.

@@ -1,0 +1,5 @@
+"""Supervised host runtime: liveness, restarts, graceful degradation."""
+
+from mercury_tpu.runtime.supervisor import HostSupervisor
+
+__all__ = ["HostSupervisor"]

@@ -1,0 +1,436 @@
+"""HostSupervisor: the failure half of the control loop.
+
+PR 6's anomaly engine *detects* trouble; this supervisor *acts on it*.
+It watches the liveness of every managed host thread fleet (scorer
+fleet, prefetch pipeline), restarts dead units with exponential backoff
+under a restart budget, and — when the budget is exhausted — walks an
+explicit degradation ladder for the importance-sampling plane instead
+of taking the run down:
+
+    level 0  ASYNC    scorer fleet refreshes the table in the background
+    level 1  SYNC     the trainer thread scores chunks itself
+                      (``ScorerFleet.score_once`` — no worker threads)
+    level 2  FROZEN   no refresh at all; the table's in-graph staleness
+                      decay keeps flattening it toward the EMA mean
+    level 3  UNIFORM  the table is flattened to a constant, so the
+                      inverse-CDF draw IS uniform sampling
+                      (``sampler/is_active=0``)
+
+No level transition retraces anything: the fused step program never
+changes — only which host-side refresh path feeds the device table
+(levels 0/1), whether it is fed at all (2), or whether its contents are
+constant (3). This is the principled safe mode of arXiv:1803.00942:
+when importance estimates can't be trusted, sample uniformly.
+
+Recovery probing climbs back up: every ``probe_every`` steps a probe
+callback (a trainer-thread ``score_once``) is attempted; each success
+climbs one level, and the final climb into level 0 revives the worker
+fleet with a fresh restart budget. Each probe *failure* at a degraded
+level escalates one further level — a persistent fault therefore walks
+the ladder deterministically to uniform sampling and stays there,
+probing, until the fault clears.
+
+Every transition (restart, degrade, recover) is logged, counted in the
+``supervisor/*`` telemetry, and dumped as a flight record through the
+anomaly engine's recorder, so the degraded-but-green run leaves a
+complete post-mortem trail.
+
+Decisions and restarts happen on the trainer thread via :meth:`tick`
+(deterministic, testable). The optional monitor thread
+(``poll_s > 0``, name ``mercury-supervisor``) only samples liveness
+between ticks so a mid-interval death is timestamped; it never mutates
+units or the ladder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.runtime.supervisor")
+
+__all__ = ["HostSupervisor", "LEVEL_NAMES"]
+
+#: Degradation-ladder level names, index == level.
+LEVEL_NAMES = ("async", "sync", "frozen", "uniform")
+
+
+class _Unit:
+    """One supervised thread fleet (mutable restart state)."""
+
+    __slots__ = ("name", "alive_fn", "restart_fn", "escalates",
+                 "restarts_used", "next_restart_t", "exhausted_handled",
+                 "last_alive_t", "down_since_t")
+
+    def __init__(self, name: str, alive_fn: Callable[[], bool],
+                 restart_fn: Callable[[], None], escalates: bool) -> None:
+        self.name = name
+        self.alive_fn = alive_fn
+        self.restart_fn = restart_fn
+        self.escalates = escalates
+        self.restarts_used = 0
+        self.next_restart_t = 0.0
+        self.exhausted_handled = False
+        self.last_alive_t = time.monotonic()
+        self.down_since_t: Optional[float] = None
+
+
+class HostSupervisor:
+    """Liveness + restart + degradation-ladder state machine.
+
+    Wiring (``train/trainer.py``): units register with an ``alive``
+    probe and a ``restart`` action; the sampler ladder gets a ``probe``
+    (attempt one trainer-thread scoring round) and a ``revive`` (respawn
+    the worker fleet) callback. The trainer calls :meth:`tick` once per
+    fit iteration and merges :meth:`stats` at the log gate; it reads
+    :meth:`level` to choose the refresh path. The writer's drain thread
+    feeds :meth:`observe_record` (the anomaly observer path) so the
+    supervisor sees every host metric record — its heartbeat of the
+    metric plane itself.
+    """
+
+    def __init__(self, *, restart_budget: int = 3, backoff_s: float = 0.5,
+                 probe_every: int = 200, poll_s: float = 0.0,
+                 anomaly=None) -> None:
+        self._budget = max(int(restart_budget), 0)
+        self._backoff_s = max(float(backoff_s), 0.0)
+        self._probe_every = max(int(probe_every), 0)
+        self._anomaly = anomaly
+        self._units: List[_Unit] = []
+        self._probe_fn: Optional[Callable[[], None]] = None
+        self._revive_fn: Optional[Callable[[], None]] = None
+        # One lock guards all mutable supervisor state: tick() (trainer
+        # thread), observe_record() (writer drain thread) and the
+        # monitor thread all touch it.
+        self._lock = threading.Lock()
+        self._level = 0
+        self._next_probe_step = 0
+        self._restarts = 0
+        self._degradations = 0
+        self._recoveries = 0
+        self._last_record_step = -1
+        self._last_record_t = 0.0
+        self._transitions: List[Dict[str, Any]] = []
+        self._closed = False
+        self._poll_s = max(float(poll_s), 0.0)
+        self._thread: Optional[threading.Thread] = None
+        if self._poll_s > 0.0:
+            self._thread = threading.Thread(
+                target=self._poll_loop, name="mercury-supervisor",
+                daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------- wiring
+    def register_unit(self, name: str, alive: Callable[[], bool],
+                      restart: Callable[[], None],
+                      escalates: bool = False) -> None:
+        """Supervise a thread fleet. ``escalates=True`` routes its
+        budget exhaustion into the degradation ladder (the scorer
+        plane); False means exhaustion is terminal for that unit and
+        its failure propagates to the caller (the prefetch plane —
+        training cannot proceed without input)."""
+        with self._lock:
+            self._units.append(_Unit(name, alive, restart, escalates))
+
+    def set_ladder(self, probe: Callable[[], None],
+                   revive: Callable[[], None]) -> None:
+        """Install the recovery callbacks: ``probe`` attempts one
+        trainer-thread scoring round (raises on failure); ``revive``
+        respawns the async worker fleet for the final climb to
+        level 0."""
+        with self._lock:
+            self._probe_fn = probe
+            self._revive_fn = revive
+
+    # ------------------------------------------------------------- queries
+    def level(self) -> int:
+        """Current degradation-ladder level (0..3). Lock-free read of a
+        single published int — a stale read costs one iteration of the
+        old refresh path, and tick() republishes every step."""
+        return self._level  # graftlint: disable=GL120 -- single published int; stale read self-corrects next tick, all writes hold the lock
+
+    def level_name(self) -> str:
+        return LEVEL_NAMES[self.level()]
+
+    def sampler_active(self) -> bool:
+        """False once degraded all the way to uniform sampling."""
+        return self.level() < 3
+
+    # ---------------------------------------------------------------- tick
+    def tick(self, step: int) -> None:
+        """Per-iteration service (trainer thread): check unit liveness,
+        restart within budget/backoff, escalate on exhaustion, and run
+        the recovery probe on its cadence."""
+        now = time.monotonic()
+        with self._lock:
+            units = list(self._units)
+        for unit in units:
+            if self._safe_alive(unit):
+                with self._lock:
+                    unit.last_alive_t = now
+                    unit.down_since_t = None
+                continue
+            self._handle_down(unit, step, now)
+        self._maybe_probe(step)
+
+    def request_restart(self, name: str, step: int) -> bool:
+        """Synchronous restart of one unit (the pop()-failed hot path:
+        the trainer cannot take another step without input, so it asks
+        for the restart NOW rather than waiting for the next tick).
+        Honors the budget; honors the backoff by sleeping it out (the
+        pipeline is already stalled — a short deliberate wait beats a
+        crash-loop against a still-broken source). Returns False when
+        the budget is exhausted."""
+        with self._lock:
+            unit = self._find(name)
+        if unit is None:
+            return False
+        if unit.restarts_used >= self._budget:
+            self._note_exhausted(unit, step)
+            return False
+        wait = unit.next_restart_t - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        return self._try_restart(unit, step)
+
+    def report_failure(self, source: str, step: int,
+                       exc: BaseException) -> None:
+        """A degraded-path action failed on the trainer thread (e.g. the
+        level-1 sync refresh raised): escalate one level."""
+        self._degrade(step, f"{source} failed: "
+                            f"{type(exc).__name__}: {exc}")
+
+    # ------------------------------------------------------ unit handling
+    def _find(self, name: str) -> Optional[_Unit]:
+        for u in self._units:  # graftlint: disable=GL120 -- lock-held helper: every caller wraps _find() in `with self._lock`; taking the non-reentrant lock here would deadlock
+            if u.name == name:
+                return u
+        return None
+
+    def _safe_alive(self, unit: _Unit) -> bool:
+        try:
+            return bool(unit.alive_fn())
+        except Exception as exc:
+            _log.warning("supervisor: alive probe for %s raised: %s",
+                         unit.name, exc)
+            return False
+
+    def _handle_down(self, unit: _Unit, step: int, now: float) -> None:
+        with self._lock:
+            if unit.down_since_t is None:
+                unit.down_since_t = now
+            exhausted = unit.restarts_used >= self._budget
+            backing_off = now < unit.next_restart_t
+        if exhausted:
+            self._note_exhausted(unit, step)
+            return
+        if backing_off:
+            return
+        self._try_restart(unit, step)
+
+    def _try_restart(self, unit: _Unit, step: int) -> bool:
+        with self._lock:
+            unit.restarts_used += 1
+            attempt = unit.restarts_used
+            # Exponential backoff before the NEXT attempt may run.
+            unit.next_restart_t = (time.monotonic()
+                                   + self._backoff_s * (2 ** (attempt - 1)))
+            self._restarts += 1
+        try:
+            unit.restart_fn()
+        except Exception as exc:
+            _log.warning("supervisor: restart %d/%d of %s FAILED: %s: %s",
+                         attempt, self._budget, unit.name,
+                         type(exc).__name__, exc)
+            self._flight("supervisor_restart_failed", step, {
+                "unit": unit.name, "attempt": attempt,
+                "budget": self._budget,
+                "error": f"{type(exc).__name__}: {exc}",
+            })
+            return False
+        with self._lock:
+            unit.down_since_t = None
+            unit.exhausted_handled = False
+        _log.warning("supervisor: restarted %s (attempt %d/%d) at step %d",
+                     unit.name, attempt, self._budget, step)
+        self._flight("supervisor_restart", step, {
+            "unit": unit.name, "attempt": attempt, "budget": self._budget,
+        })
+        return True
+
+    def _note_exhausted(self, unit: _Unit, step: int) -> None:
+        with self._lock:
+            if unit.exhausted_handled:
+                return
+            unit.exhausted_handled = True
+            escalates = unit.escalates
+        if escalates:
+            self._degrade(step, f"{unit.name} restart budget "
+                                f"({self._budget}) exhausted")
+        else:
+            _log.warning(
+                "supervisor: %s is down with its restart budget (%d) "
+                "exhausted — its next failure propagates to the caller",
+                unit.name, self._budget)
+            self._flight("supervisor_exhausted", step, {
+                "unit": unit.name, "budget": self._budget,
+            })
+
+    # ------------------------------------------------------------- ladder
+    def _degrade(self, step: int, reason: str) -> None:
+        with self._lock:
+            if self._level >= len(LEVEL_NAMES) - 1:
+                return
+            src = self._level
+            self._level = src + 1
+            dst = self._level
+            self._degradations += 1
+            self._transitions.append({
+                "step": step, "from": LEVEL_NAMES[src],
+                "to": LEVEL_NAMES[dst], "reason": reason,
+            })
+        _log.warning("supervisor: DEGRADE %s -> %s at step %d (%s)",
+                     LEVEL_NAMES[src], LEVEL_NAMES[dst], step, reason)
+        self._flight("supervisor_degrade", step, {
+            "from": LEVEL_NAMES[src], "to": LEVEL_NAMES[dst],
+            "reason": reason,
+        })
+
+    def _recover(self, step: int, reason: str) -> None:
+        with self._lock:
+            if self._level <= 0:
+                return
+            src = self._level
+            self._level = src - 1
+            dst = self._level
+            self._recoveries += 1
+            if dst == 0:
+                # Back to nominal: the fleet earned a fresh budget.
+                for u in self._units:
+                    if u.escalates:
+                        u.restarts_used = 0
+                        u.exhausted_handled = False
+                        u.next_restart_t = 0.0
+            self._transitions.append({
+                "step": step, "from": LEVEL_NAMES[src],
+                "to": LEVEL_NAMES[dst], "reason": reason,
+            })
+        _log.warning("supervisor: RECOVER %s -> %s at step %d (%s)",
+                     LEVEL_NAMES[src], LEVEL_NAMES[dst], step, reason)
+        self._flight("supervisor_recover", step, {
+            "from": LEVEL_NAMES[src], "to": LEVEL_NAMES[dst],
+            "reason": reason,
+        })
+
+    def _maybe_probe(self, step: int) -> None:
+        with self._lock:
+            due = (self._level > 0 and self._probe_every > 0
+                   and step >= self._next_probe_step)
+            if due:
+                self._next_probe_step = step + self._probe_every
+            probe = self._probe_fn
+            revive = self._revive_fn
+            level = self._level
+        if not due or probe is None:
+            return
+        try:
+            if level == 1 and revive is not None:
+                # The last climb needs live workers, not just a working
+                # score path: revive the fleet, then verify it scored.
+                revive()
+            probe()
+        except Exception as exc:
+            self.report_failure("recovery probe", step, exc)
+            return
+        self._recover(step, "recovery probe succeeded")
+
+    # ------------------------------------------------- observer / monitor
+    def observe_record(self, record: Dict[str, float]) -> None:
+        """Writer-observer hook (drain thread): timestamp the metric
+        plane's heartbeat. Never raises (the writer contract counts
+        observer failures, but a supervisor that takes down telemetry
+        would be absurd)."""
+        try:
+            with self._lock:
+                self._last_record_step = int(record.get("step", -1))
+                self._last_record_t = time.monotonic()
+        except Exception:
+            pass
+
+    def _poll_loop(self) -> None:
+        """Monitor thread: timestamp unit liveness between ticks. Reads
+        the alive probes and stamps per-unit times under the lock —
+        restarts and ladder moves stay on the trainer thread."""
+        while not self._closed:
+            now = time.monotonic()
+            with self._lock:
+                units = list(self._units)
+            for unit in units:
+                if self._safe_alive(unit):
+                    with self._lock:
+                        unit.last_alive_t = now
+                else:
+                    with self._lock:
+                        if unit.down_since_t is None:
+                            unit.down_since_t = now
+            deadline = time.monotonic() + self._poll_s
+            while not self._closed:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                time.sleep(min(left, 0.05))
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the monitor thread (idempotent; daemon, so a wedged
+        probe never blocks exit)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    # ----------------------------------------------------------- telemetry
+    def _flight(self, kind: str, step: int, detail: Dict[str, Any]) -> None:
+        if self._anomaly is None:
+            return
+        try:
+            self._anomaly.dump_flight_record(kind, step, detail)
+        except Exception as exc:  # defensive: recorder never takes us down
+            _log.warning("supervisor: flight record %s failed: %s",
+                         kind, exc)
+
+    def stats(self) -> Dict[str, float]:
+        """Log-gate scalars (keys registered in obs/registry.py)."""
+        with self._lock:
+            down = sum(1 for u in self._units
+                       if u.down_since_t is not None)
+            return {
+                "supervisor/level": float(self._level),
+                "supervisor/restarts": float(self._restarts),
+                "supervisor/degradations": float(self._degradations),
+                "supervisor/recoveries": float(self._recoveries),
+                "supervisor/units_down": float(down),
+                "sampler/is_active": 0.0 if self._level >= 3 else 1.0,
+            }
+
+    def summary(self) -> Dict[str, Any]:
+        """Cumulative view for flight-record context dumps."""
+        with self._lock:
+            return {
+                "level": self._level,
+                "level_name": LEVEL_NAMES[self._level],
+                "restart_budget": self._budget,
+                "restarts": self._restarts,
+                "degradations": self._degradations,
+                "recoveries": self._recoveries,
+                "last_record_step": self._last_record_step,
+                "transitions": list(self._transitions),
+                "units": [
+                    {"name": u.name, "restarts_used": u.restarts_used,
+                     "down": u.down_since_t is not None}
+                    for u in self._units
+                ],
+            }

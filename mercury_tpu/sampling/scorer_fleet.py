@@ -47,6 +47,7 @@ import numpy as np
 
 from mercury_tpu.config import TrainConfig
 from mercury_tpu.data.pipeline import augment_batch, normalize_images
+from mercury_tpu.faults import InjectedFault
 from mercury_tpu.obs.trace import NULL_TRACER
 from mercury_tpu.sampling.importance import (
     per_sample_grad_norm_bound,
@@ -100,6 +101,7 @@ class ScorerFleet:
         std: np.ndarray,
         config: TrainConfig,
         tracer=None,
+        faults=None,
     ) -> None:
         self._x = np.asarray(x_train)
         self._y = np.asarray(y_train)
@@ -113,6 +115,9 @@ class ScorerFleet:
         self._std = std
         self._config = config
         self._tracer = tracer if tracer is not None else NULL_TRACER
+        # Fault-injection plane (mercury_tpu/faults.py); None when
+        # disabled — every hook site below is a plain attribute check.
+        self._faults = faults
 
         if config.augmentation == "noniid":
             self._augment = lambda k, im: augment_batch(
@@ -154,9 +159,23 @@ class ScorerFleet:
             maxsize=max(2 * self._workers, 2))
         self._exc: Optional[BaseException] = None
         self._closed = False
+        self._generation = 0     # bumped per restart_workers() respawn
+        self._restarts = 0
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._spawn_workers()
+
+    def _spawn_workers(self) -> None:
+        """(Re)spawn the worker set for the current generation. Names
+        carry a ``-rN`` generation suffix after a restart so the Layer C
+        thread census can tell a supervisor respawn from a leak."""
+        gen = self._generation
+        suffix = f"-r{gen}" if gen else ""
+        self._stop = threading.Event()
+        stop = self._stop
         self._threads = [
-            threading.Thread(target=self._run, args=(i,), daemon=True,
-                             name=f"mercury-scorer-{i}")
+            threading.Thread(target=self._run, args=(i, stop), daemon=True,
+                             name=f"mercury-scorer-{i}{suffix}")
             for i in range(self._workers)
         ]
         for t in self._threads:
@@ -206,6 +225,12 @@ class ScorerFleet:
         snap = self._snap
         if snap is None:
             return None
+        faults = self._faults
+        if faults is not None and faults.fire("scorer_die") is not None:
+            # Kills whichever thread is scoring: a fleet worker (the
+            # supervisor's restart path) or the trainer's sync-refresh /
+            # recovery-probe call (the ladder's escalation path).
+            raise InjectedFault("scorer_die: injected scorer death")
         params, batch_stats, snap_step = snap
         with self._lock:
             start = self._cursor
@@ -221,6 +246,10 @@ class ScorerFleet:
         # Device sync on the fleet thread — absorbing it off the trainer
         # thread is the fleet's whole purpose.
         scores_h = np.asarray(scores, np.float32)  # graftlint: disable=GL114 -- worker-side device sync: the fleet thread absorbs the fetch so the trainer never waits on scoring
+        if faults is not None and faults.fire("scorer_nan") is not None:
+            # Chunk corruption: the trainer's apply guard must reject
+            # this chunk instead of scattering NaN into the table.
+            scores_h = np.full_like(scores_h, np.nan)
         with self._lock:
             self._chunks_scored += 1
             self._rows_scored += self._W * self._R
@@ -242,10 +271,13 @@ class ScorerFleet:
                 "before score_once()")
         return chunk
 
-    def _run(self, idx: int) -> None:
+    def _run(self, idx: int, stop: threading.Event) -> None:
+        # ``stop`` is this GENERATION's retirement flag: restart_workers
+        # sets it so the old set exits while the fleet object lives on
+        # with a fresh set; close() sets the current one.
         self._tracer.register_thread(f"scorer{idx}")
         try:
-            while not self._closed:
+            while not (self._closed or stop.is_set()):
                 if self._snap is None:
                     time.sleep(0.005)
                     continue
@@ -260,7 +292,7 @@ class ScorerFleet:
                 # queue means the trainer is ahead of its drain cadence —
                 # idle here (backpressure) rather than stockpile chunks
                 # that would only grow staler.
-                while not self._closed:
+                while not (self._closed or stop.is_set()):
                     try:
                         self._ready.put(chunk, timeout=0.1)
                         break
@@ -270,7 +302,7 @@ class ScorerFleet:
                 # core between chunks, in short slices so close() never
                 # waits out a long sleep.
                 deadline = time.perf_counter() + self._throttle
-                while not self._closed:
+                while not (self._closed or stop.is_set()):
                     left = deadline - time.perf_counter()
                     if left <= 0:
                         break
@@ -325,6 +357,42 @@ class ScorerFleet:
         with self._lock:
             self._ages = []
 
+    def alive(self) -> bool:
+        """Liveness probe for the supervisor: False once any worker has
+        died (``_exc`` set), a thread has exited, or the fleet is
+        closed. Reads only single-writer published flags — no lock."""
+        if self._closed or self._exc is not None:
+            return False
+        return all(t.is_alive() for t in self._threads)
+
+    def restart_workers(self, timeout: float = 5.0) -> int:
+        """Supervisor restart: retire the current worker generation
+        (its ``stop`` event ends live threads; dead ones just join),
+        clear the failure latch, and respawn the full set under
+        ``-rN``-suffixed names. Queued chunks survive — they were
+        scored from a valid snapshot before the death. Returns the new
+        generation number."""
+        if self._closed:
+            raise RuntimeError("restart_workers() on a closed ScorerFleet")
+        self._stop.set()
+        deadline = time.perf_counter() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.perf_counter()))
+        wedged = [t.name for t in self._threads if t.is_alive()]
+        if wedged:
+            _log.warning(
+                "scorer restart: previous-generation threads still alive "
+                "%.0fs after stop — abandoning wedged (daemon): %s",
+                timeout, ", ".join(wedged))
+        self._exc = None  # graftlint: disable=GL120 -- prior generation is stopped+joined above; an abandoned wedged worker exits via its generation's stop event without writing the latch
+        self._generation += 1
+        with self._lock:
+            self._restarts += 1
+        self._spawn_workers()
+        _log.warning("scorer fleet restarted: generation %d (%d workers)",
+                     self._generation, self._workers)
+        return self._generation
+
     def close(self, timeout: float = 30.0) -> None:
         """Idempotent shutdown: stop the workers and join them with a
         bounded wait — a wedged scorer (e.g. stuck in device compute)
@@ -332,6 +400,7 @@ class ScorerFleet:
         if self._closed:
             return
         self._closed = True
+        self._stop.set()
         deadline = time.perf_counter() + timeout
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.perf_counter()))
@@ -372,9 +441,13 @@ class ScorerFleet:
         # honest — the lock below guards only the counters.
         snap = self._snap
         closed = self._closed
+        alive = sum(1 for t in self._threads if t.is_alive())
         with self._lock:
             return {
                 "workers": self._workers,
+                "workers_alive": alive,
+                "generation": self._generation,
+                "restarts": self._restarts,
                 "chunk_shape": [self._W, self._R],
                 "chunks_scored": self._chunks_scored,
                 "rows_scored": self._rows_scored,

@@ -19,16 +19,39 @@ hosts (NFS/GCS) in multi-host runs.
 from __future__ import annotations
 
 import functools
+import hashlib
+import json
 import os
 import re
+import threading
 import time
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 
 from mercury_tpu.utils.logging import get_logger
 
 _log = get_logger("mercury_tpu.train.checkpoint")
+
+# Failed write ATTEMPTS (a save that succeeds on retry 2 still counts 2):
+# the trainer folds this into the log gate as ``checkpoint/write_failures``
+# so a flaky checkpoint filesystem is visible long before a restore needs
+# the file. Incremented from both the trainer thread (sync saves) and
+# ckpt-write-* threads (async saves), hence the lock.
+_fail_lock = threading.Lock()
+_write_failures = 0
+
+
+def write_failures() -> int:
+    """Cumulative failed checkpoint-write attempts in this process."""
+    with _fail_lock:
+        return _write_failures
+
+
+def _count_write_failure() -> None:
+    global _write_failures
+    with _fail_lock:
+        _write_failures += 1
 
 
 def _orbax():
@@ -100,12 +123,24 @@ def _host_gather(tree: Any) -> Any:
     return jax.device_get(jax.tree_util.tree_map(fetch, tree))
 
 
-def save_checkpoint(directory: str, state: Any, step: int) -> str:
+def save_checkpoint(directory: str, state: Any, step: int, *,
+                    keep: int = 0, retries: int = 0,
+                    retry_backoff_s: float = 0.25, manifest: bool = False,
+                    faults=None) -> str:
     """Save ``state`` under ``directory/ckpt_<step>``.
 
     Multi-controller: all processes participate in the host gather (a
     collective), then only process 0 writes — a shared checkpoint
-    directory sees exactly one writer."""
+    directory sees exactly one writer.
+
+    Durability knobs (all default-off so direct callers keep the seed
+    behavior): ``manifest=True`` writes a ``ckpt_<step>.manifest.json``
+    sidecar with whole-file + per-leaf sha256 (and forces the msgpack
+    backend, whose bytes the manifest describes, over Orbax);
+    ``retries``/``retry_backoff_s`` retry transient ``OSError`` writes
+    with exponential backoff; ``keep`` prunes to the newest N generations
+    after a successful write. ``faults`` threads the injection plane
+    through to the write hook."""
     os.makedirs(directory, exist_ok=True)
     path = _ckpt_path(directory, step)
     to_save = _host_gather(_unwrap_keys(state))
@@ -118,21 +153,30 @@ def save_checkpoint(directory: str, state: Any, step: int) -> str:
         # on process 0 re-raises there instead of hanging everyone else.
         try:
             if jax.process_index() == 0:
-                _write_msgpack(path, to_save)
+                _write_with_retries(
+                    path, to_save, retries=retries,
+                    retry_backoff_s=retry_backoff_s, manifest=manifest,
+                    faults=faults)
+                _prune_old(directory, keep)
         finally:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices(f"mercury_ckpt_save_{step}")
         return path
-    ocp = _orbax()
-    if ocp is not None:
-        try:
-            ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.abspath(path), to_save, force=True)
-            return path
-        except Exception:
-            pass
-    _write_msgpack(path, to_save)
+    if not manifest:
+        ocp = _orbax()
+        if ocp is not None:
+            try:
+                ckptr = ocp.PyTreeCheckpointer()
+                ckptr.save(os.path.abspath(path), to_save, force=True)
+                _prune_old(directory, keep)
+                return path
+            except Exception:
+                pass
+    _write_with_retries(path, to_save, retries=retries,
+                        retry_backoff_s=retry_backoff_s, manifest=manifest,
+                        faults=faults)
+    _prune_old(directory, keep)
     return path
 
 
@@ -163,21 +207,87 @@ def _sweep_stale_tmps(directory: str, min_age_secs: float = 300.0) -> None:
         pass
 
 
-def _write_msgpack(path: str, to_save: Any) -> None:
+def _leaf_digests(to_save: Any) -> Dict[str, str]:
+    """Per-leaf sha256 of the HOST value bytes, keyed by keypath string.
+    Restore verifies these after parsing, so a bit flip localizes to the
+    leaf it hit (``params/conv1/kernel``) instead of "file bad"."""
+    import numpy as np
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(to_save)
+    out: Dict[str, str] = {}
+    for kp, leaf in leaves:
+        arr = np.asarray(leaf)
+        out[jax.tree_util.keystr(kp)] = hashlib.sha256(
+            arr.tobytes()).hexdigest()
+    return out
+
+
+def _manifest_path(path: str) -> str:
+    return path + ".manifest.json"
+
+
+def _write_manifest(path: str, file_sha: str, nbytes: int, step: int,
+                    to_save: Any) -> None:
+    """Atomic sidecar write (tmp + replace, same discipline as the
+    payload). Ordered AFTER the payload rename: a crash in the gap
+    leaves a checkpoint without a manifest — restore then skips
+    verification (back-compat), never a manifest describing a file that
+    does not exist."""
+    doc = {
+        "schema": "mercury-ckpt-manifest-v1",
+        "step": int(step),
+        "file": os.path.basename(path) + ".msgpack",
+        "sha256": file_sha,
+        "bytes": int(nbytes),
+        "leaves": _leaf_digests(to_save),
+    }
+    final = _manifest_path(path)
+    tmp = final + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _write_msgpack(path: str, to_save: Any, *, manifest: bool = False,
+                   faults=None) -> None:
     """Atomic write: serialize to a temp file, then ``os.replace`` into
     place. A hard crash (SIGKILL/preemption — the exact scenario
     ``auto_resume`` targets) mid-write therefore leaves only a stray
     ``.tmp``, never a truncated ``ckpt_<step>.msgpack`` that
-    :func:`latest_step` would pick as newest."""
+    :func:`latest_step` would pick as newest. Any failure unlinks the
+    partial ``.tmp`` before re-raising — retries and crashed saves must
+    not accumulate checkpoint-sized orphans in a (possibly shared)
+    directory."""
     import flax.serialization
 
+    if faults is not None and faults.fire("ckpt_io_error") is not None:
+        # Before the open(): the injected failure models ENOSPC/EIO at
+        # the filesystem boundary, and must leave no partial state.
+        raise OSError("ckpt_io_error: injected checkpoint write failure")
     final = path + ".msgpack"
     tmp = final + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(flax.serialization.to_bytes(to_save))
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, final)
+    try:
+        blob = flax.serialization.to_bytes(to_save)
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     # fsync the directory too: the rename itself is metadata, and on a
     # journaled filesystem a crash right after os.replace can otherwise
     # lose the directory entry for the new name.
@@ -186,6 +296,55 @@ def _write_msgpack(path: str, to_save: Any) -> None:
         os.fsync(dir_fd)
     finally:
         os.close(dir_fd)
+    if manifest:
+        m = re.search(r"ckpt_(\d+)$", path)
+        step = int(m.group(1)) if m else -1
+        _write_manifest(path, hashlib.sha256(blob).hexdigest(), len(blob),
+                        step, to_save)
+
+
+def _write_with_retries(path: str, to_save: Any, *, retries: int = 0,
+                        retry_backoff_s: float = 0.25,
+                        manifest: bool = False, faults=None) -> None:
+    """Retry transient ``OSError`` writes with exponential backoff.
+    Every failed ATTEMPT bumps the ``checkpoint/write_failures`` counter
+    — a save that eventually lands still leaves its flakiness visible in
+    telemetry."""
+    attempt = 0
+    while True:
+        try:
+            _write_msgpack(path, to_save, manifest=manifest, faults=faults)
+            return
+        except OSError as exc:
+            attempt += 1
+            _count_write_failure()
+            if attempt > max(int(retries), 0):
+                raise
+            delay = retry_backoff_s * (2 ** (attempt - 1))
+            _log.warning(
+                "checkpoint write %s failed (attempt %d/%d): %s — "
+                "retrying in %.2fs", path, attempt, retries + 1, exc, delay)
+            time.sleep(delay)
+
+
+def _prune_old(directory: str, keep: int) -> None:
+    """Keep the newest ``keep`` checkpoint generations (``keep <= 0``
+    keeps everything). Process 0 only, and only after a successful save
+    — a failed write must never trigger pruning, or a string of failures
+    would walk the directory down to zero restorable checkpoints."""
+    if keep <= 0 or jax.process_index() != 0:
+        return
+    for step in all_steps(directory)[:-keep]:
+        base = _ckpt_path(directory, step)
+        for path in (base + ".msgpack", _manifest_path(base)):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        if os.path.isdir(base):
+            import shutil
+
+            shutil.rmtree(base, ignore_errors=True)
 
 
 class _AsyncSave:
@@ -194,9 +353,7 @@ class _AsyncSave:
     writer thread hit (a silently missing cadence checkpoint would
     otherwise surface only as a much older restore after a preemption)."""
 
-    def __init__(self, target, name: str):
-        import threading
-
+    def __init__(self, target, name: str, failure_cb=None):
         self._exc: Optional[BaseException] = None
 
         def runner():
@@ -204,10 +361,27 @@ class _AsyncSave:
                 target()
             except BaseException as e:  # re-raised at join
                 self._exc = e
+                if failure_cb is not None:
+                    try:
+                        # Out-of-band failure report (the supervisor):
+                        # join() may be a full cadence away, and a wedged
+                        # run never joins at all.
+                        failure_cb(e)
+                    except Exception:
+                        _log.warning("checkpoint failure_cb raised",
+                                     exc_info=True)
 
         self._thread = threading.Thread(target=runner, name=name,
                                         daemon=False)
         self._thread.start()
+
+    def done(self) -> bool:
+        """True once the writer thread finished (success OR failure)."""
+        return not self._thread.is_alive()
+
+    def failed(self) -> Optional[BaseException]:
+        """The writer's exception, if it has failed (non-blocking)."""
+        return self._exc
 
     def join(self, timeout: Optional[float] = 600.0) -> None:
         """Wait for the write (default bound: 10 minutes — a full
@@ -216,7 +390,9 @@ class _AsyncSave:
         than hanging shutdown forever on a wedged filesystem: the
         thread is non-daemon, so the interpreter will still wait on it
         at exit, but the caller gets a loud, attributable failure
-        instead of a silent hang here."""
+        instead of a silent hang here. If the writer had ALSO already
+        latched an exception, it is chained as the TimeoutError's cause
+        rather than silently shadowed."""
         self._thread.join(timeout=timeout)
         if self._thread.is_alive():
             _log.warning("checkpoint writer %r still running after "
@@ -224,31 +400,46 @@ class _AsyncSave:
                          self._thread.name, timeout)
             raise TimeoutError(
                 f"checkpoint write ({self._thread.name}) did not "
-                f"finish within {timeout:.0f}s")
+                f"finish within {timeout:.0f}s") from self._exc
         if self._exc is not None:
             raise self._exc
 
 
-def save_checkpoint_async(directory: str, state: Any, step: int):
+def save_checkpoint_async(directory: str, state: Any, step: int, *,
+                          keep: int = 0, retries: int = 0,
+                          retry_backoff_s: float = 0.25,
+                          manifest: bool = False, faults=None,
+                          failure_cb=None):
     """Non-blocking save: the device→host fetch happens synchronously (it
     must — the caller's next train step donates/overwrites the state
     buffers), then serialization + file IO run on a background thread so
     training resumes immediately. Returns an :class:`_AsyncSave` handle —
     ``join()`` it before reading the file or exiting; writer-thread
-    failures re-raise there.
+    failures re-raise there. ``failure_cb(exc)`` additionally fires ON
+    the writer thread at failure time (the supervisor's prompt signal).
+    Durability knobs as in :func:`save_checkpoint`.
 
     Single-process only: multi-controller saves need their cross-process
     barrier to stay on the caller's thread (collective ordering), so this
     falls back to the synchronous path there (returning ``None``).
     """
     if jax.process_count() > 1:
-        save_checkpoint(directory, state, step)
+        save_checkpoint(directory, state, step, keep=keep, retries=retries,
+                        retry_backoff_s=retry_backoff_s, manifest=manifest,
+                        faults=faults)
         return None
     os.makedirs(directory, exist_ok=True)
     path = _ckpt_path(directory, step)
     to_save = _host_gather(_unwrap_keys(state))
-    return _AsyncSave(lambda: _write_msgpack(path, to_save),
-                      name=f"ckpt-write-{step}")
+
+    def write():
+        _write_with_retries(path, to_save, retries=retries,
+                            retry_backoff_s=retry_backoff_s,
+                            manifest=manifest, faults=faults)
+        _prune_old(directory, keep)
+
+    return _AsyncSave(write, name=f"ckpt-write-{step}",
+                      failure_cb=failure_cb)
 
 
 def all_steps(directory: str) -> list:
@@ -269,7 +460,9 @@ def latest_step(directory: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
-def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None) -> Tuple[Any, int]:
+def restore_checkpoint(directory: str, template: Any,
+                       step: Optional[int] = None, *,
+                       verify: bool = True) -> Tuple[Any, int]:
     """Restore the checkpoint at ``step`` (default: latest) into the
     structure of ``template`` (a live state used for pytree/shape/dtype
     reference). Returns ``(state, step)``.
@@ -289,9 +482,15 @@ def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None
     more checkpoints than that on disk, the multi-host fallback walk stops
     after 256 candidates rather than trying every older file — 256
     consecutive corrupt checkpoints means the directory, not a torn write,
-    is the problem."""
+    is the problem.
+
+    ``verify=True`` (default) checks each msgpack candidate against its
+    sha256 manifest sidecar when one exists — whole-file digest before
+    parsing, per-leaf digests after — so silent corruption (a bit flip
+    that still deserializes) is caught and falls back exactly like a torn
+    file. Checkpoints without a sidecar restore unverified (back-compat)."""
     if step is not None:
-        return _restore_one(directory, template, step), step
+        return _restore_one(directory, template, step, verify=verify), step
     _sweep_stale_tmps(directory)
     steps = all_steps(directory)
     multi = jax.process_count() > 1
@@ -328,7 +527,8 @@ def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None
     errors = []
     for candidate in reversed(steps):
         try:
-            restored = _restore_one(directory, template, candidate)
+            restored = _restore_one(directory, template, candidate,
+                                    verify=verify)
             local_ok, err = True, None
         except Exception as e:  # corrupt/partial file — try older
             restored, local_ok, err = None, False, e
@@ -352,7 +552,22 @@ def restore_checkpoint(directory: str, template: Any, step: Optional[int] = None
     )
 
 
-def _restore_one(directory: str, template: Any, step: int) -> Any:
+def _load_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The sidecar, or None when absent/unreadable (unverified restore —
+    a corrupt sidecar should not defeat a good checkpoint; per-file
+    integrity still catches payload damage when the sidecar IS good)."""
+    try:
+        with open(_manifest_path(path)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get("schema") != "mercury-ckpt-manifest-v1":
+        return None
+    return doc
+
+
+def _restore_one(directory: str, template: Any, step: int,
+                 verify: bool = True) -> Any:
     path = _ckpt_path(directory, step)
     # Only the template's structure/shapes/dtypes matter (the deserializer
     # overwrites every value) — build host zeros rather than fetching (or,
@@ -371,7 +586,34 @@ def _restore_one(directory: str, template: Any, step: int) -> Any:
         import flax.serialization
 
         with open(path + ".msgpack", "rb") as f:
-            restored = flax.serialization.from_bytes(template_data, f.read())
+            blob = f.read()
+        doc = _load_manifest(path) if verify else None
+        if doc is not None:
+            # Whole-file digest BEFORE parsing: a torn/flipped file can
+            # still deserialize into plausible garbage, and raising here
+            # lets restore_checkpoint's fallback walk treat it exactly
+            # like a parse failure.
+            got = hashlib.sha256(blob).hexdigest()
+            if got != doc["sha256"]:
+                raise ValueError(
+                    f"ckpt_{step}.msgpack sha256 mismatch: manifest "
+                    f"{doc['sha256'][:12]}…, file {got[:12]}… "
+                    f"({len(blob)} bytes vs {doc.get('bytes')} recorded)")
+        restored = flax.serialization.from_bytes(template_data, blob)
+        if doc is not None and doc.get("leaves"):
+            flat, _ = jax.tree_util.tree_flatten_with_path(restored)
+            have = {jax.tree_util.keystr(kp): leaf for kp, leaf in flat}
+            for key, want in doc["leaves"].items():
+                if key not in have:
+                    raise ValueError(
+                        f"ckpt_{step} manifest names leaf {key!r} absent "
+                        "from the restored tree")
+                got = hashlib.sha256(
+                    np.asarray(have[key]).tobytes()).hexdigest()
+                if got != want:
+                    raise ValueError(
+                        f"ckpt_{step} leaf {key!r} sha256 mismatch "
+                        "(corrupt value survived deserialization)")
     # Pull everything to host first — orbax otherwise hands back arrays
     # committed to device 0 with layouts of ITS choosing, which conflicts
     # with a multi-device mesh.

@@ -485,6 +485,16 @@ class Trainer:
                                           else "none",
                                           mesh=eval_mesh,
                                           axis=config.mesh_axis)
+        # --- fault-injection plane (mercury_tpu/faults.py): built BEFORE
+        # every subsystem that hooks into it (metric writer, prefetch
+        # pipeline, scorer fleet, checkpoint writes, the fit loop). None
+        # when disabled — each hook site is a plain attribute check and
+        # the traced step program is byte-identical (Layer-2/3 digests).
+        self._faults = None
+        if config.fault_spec:
+            from mercury_tpu.faults import FaultPlane
+
+            self._faults = FaultPlane(config.fault_spec)
         # --- observability: run manifest + non-blocking metric stream ---
         # The manifest (resolved config, jax/jaxlib versions, mesh/device
         # topology, git sha) makes the metrics stream interpretable later;
@@ -571,7 +581,28 @@ class Trainer:
             observers.append(self._host_agg.observe_record)
         if self.anomaly is not None:
             observers.append(self.anomaly.observe_record)
-        self.logger = AsyncMetricWriter(sinks, observers=observers)
+        self.logger = AsyncMetricWriter(sinks, observers=observers,
+                                        faults=self._faults)
+        # --- host supervisor (runtime/supervisor.py): liveness + restart
+        # + the degradation ladder. Units register below as the worker
+        # fleets are built; the writer-observer hook makes the supervisor
+        # see every host metric record (its heartbeat of the metric
+        # plane). Host step stash: the supervisor's probe path must never
+        # sync the device (int(self.state.step) would), so the fit loop
+        # publishes the host-side step counter here each iteration.
+        self._host_step = 0
+        self.supervisor = None
+        if config.supervise:
+            from mercury_tpu.runtime.supervisor import HostSupervisor
+
+            self.supervisor = HostSupervisor(
+                restart_budget=config.supervisor_restart_budget,
+                backoff_s=config.supervisor_backoff_s,
+                probe_every=config.supervisor_probe_every,
+                poll_s=config.supervisor_poll_s,
+                anomaly=self.anomaly,
+            )
+            self.logger.add_observer(self.supervisor.observe_record)
         # On-demand jax.profiler capture window: >0 means "this many more
         # steps, then stop_trace" (armed by an anomaly trigger).
         self._profile_steps_left = 0
@@ -636,6 +667,7 @@ class Trainer:
             self._stream_x_sharding = NamedSharding(
                 self.mesh, P(config.mesh_axis)
             )
+            self._stream_gen = 0
             self._stream_pipe = PrefetchPipeline(
                 source,
                 (config.world_size, self._stream_emit_size()),
@@ -643,7 +675,19 @@ class Trainer:
                 depth=config.prefetch_depth,
                 tracer=self.tracer,
                 local_workers=self._stream_local_workers,
+                faults=self._faults,
             )
+            if self.supervisor is not None:
+                # escalates=False: training cannot proceed without input,
+                # so past the restart budget a prefetch death propagates
+                # (there is no degraded mode that synthesizes pixels).
+                # alive reads the CURRENT pipe — restarts replace it.
+                self.supervisor.register_unit(
+                    "prefetch",
+                    alive=lambda: self._stream_pipe.alive(),
+                    restart=self._restart_stream_pipe,
+                    escalates=False,
+                )
             self._stream_prime = make_host_stream_prime(config, self.mesh)
             self.state, primed_gidx = self._stream_prime(
                 self.state, self.dataset.shard_indices
@@ -662,6 +706,13 @@ class Trainer:
         # fit loop). Built BEFORE auto_resume: a restore resets the fleet
         # via _recommit_state (queued chunks scored the old trajectory).
         self._scorer_fleet = None
+        # Non-finite chunks rejected by the apply guard (scorer_nan
+        # injection, or an organically diverged scoring forward) — the
+        # table must never be scattered with NaN.
+        self._chunks_rejected = 0
+        # Highest ladder level actually ACTUATED on the device table:
+        # the level-3 flatten runs exactly once per descent to uniform.
+        self._actuated_level = 0
         # Runtime retrace guard (graftlint Layer P): armed explicitly via
         # arm_retrace_guard(); when live, the log gate emits
         # lint/retrace_events + lint/compile_count per tick.
@@ -700,12 +751,28 @@ class Trainer:
                 self.dataset.std,
                 config,
                 tracer=self.tracer,
+                faults=self._faults,
             )
             self._apply_refresh = self._make_refresh_apply()
             self._scorer_fleet.snapshot(
                 self.state.params, self.state.batch_stats,
                 step=int(self.state.step),
             )
+            if self.supervisor is not None:
+                # escalates=True: scorer exhaustion enters the
+                # degradation ladder (the table can be refreshed on the
+                # trainer thread, frozen, or flattened to uniform —
+                # training proceeds either way).
+                self.supervisor.register_unit(
+                    "scorer",
+                    alive=lambda: self._scorer_fleet.alive(),
+                    restart=lambda: self._scorer_fleet.restart_workers(),
+                    escalates=True,
+                )
+                self.supervisor.set_ladder(
+                    probe=self._probe_scoring,
+                    revive=lambda: self._scorer_fleet.restart_workers(),
+                )
 
         # Crash/preemption recovery: pick up the newest checkpoint, sampler
         # state included (bit-deterministic IS resume). The NEXT fit() then
@@ -772,7 +839,7 @@ class Trainer:
             return int(cfg.candidate_pool_size)
         return int(cfg.batch_size)
 
-    def _host_stream_step(self):
+    def _host_stream_step(self, step: int = 0):
         """One pop→step→push cycle: train on the oldest prefetched batch,
         hand the step's emitted t+depth indices straight back to the
         pipeline (still an in-flight device value — the worker thread
@@ -781,9 +848,21 @@ class Trainer:
         # span IS the input-stall (its wall time, minus µs of queue
         # bookkeeping, is time the trainer waited on data).
         with self.tracer.span("trainer/pop", cat="trainer"):
-            batch = self._stream_pipe.pop()
+            try:
+                batch = self._stream_pipe.pop()  # graftlint: disable=GL120 -- supervisor callbacks (restart/probe/revive) run on the trainer thread only: tick()/request_restart() are fit-loop calls and the monitor thread never invokes them
+            except RuntimeError:
+                # Worker death. The trainer cannot take this step without
+                # input, so the restart is synchronous (budget + backoff
+                # via the supervisor); the rebuilt pipeline resumes from
+                # the stream cursor (state.pending_sel), so the popped
+                # batch is exactly the one the dead worker owed us — no
+                # sample skipped or duplicated.
+                if self.supervisor is None or not \
+                        self.supervisor.request_restart("prefetch", step):
+                    raise
+                batch = self._stream_pipe.pop()
         with self.tracer.span("trainer/dispatch", cat="trainer"):
-            self.state, metrics, next_gidx = self.train_step(
+            self.state, metrics, next_gidx = self.train_step(  # graftlint: disable=GL120 -- supervisor callbacks run on the trainer thread only (see pop() above); state is never touched off-thread
                 self.state, batch, self._step_y, self.dataset.shard_indices
             )
         with self.tracer.span("trainer/push", cat="trainer"):
@@ -850,6 +929,39 @@ class Trainer:
                 ])
                 self._stream_pipe.push(gidx)
 
+    def _restart_stream_pipe(self) -> None:
+        """Supervisor restart: tear down the dead pipeline and build a
+        generation-bumped replacement, resuming from the stream cursor.
+        ``state.pending_sel`` holds the selections for steps
+        t..t+depth-1 regardless of where the worker died, and
+        ``_refill_stream_pipe`` recomputes ALL depth in-flight gathers
+        from it — so the restarted trajectory is bit-identical to an
+        uninterrupted one (test-enforced)."""
+        from mercury_tpu.data.stream import HostStreamSource, PrefetchPipeline
+
+        cfg = self.config
+        old = self._stream_pipe
+        self._stream_gen += 1
+        try:
+            old.close(timeout=5.0)
+        except Exception as exc:
+            _log.warning("dead prefetch pipeline close() raised: %s", exc)
+        source = HostStreamSource(
+            np.asarray(self.dataset.x_train),
+            decode_workers=cfg.decode_workers,
+        )
+        self._stream_pipe = PrefetchPipeline(
+            source,
+            (cfg.world_size, self._stream_emit_size()),
+            self._stream_x_sharding,
+            depth=cfg.prefetch_depth,
+            tracer=self.tracer,
+            local_workers=self._stream_local_workers,
+            faults=self._faults,
+            generation=self._stream_gen,
+        )
+        self._refill_stream_pipe()
+
     # --------------------------------------------------- async scorer fleet
     def _make_refresh_apply(self):
         """Jitted ``[W]``-vmapped chunk scatter for the async fleet
@@ -876,35 +988,142 @@ class Trainer:
             out_shardings=ScoreTableState(scores=sh, cursor=sh),
         )
 
+    def _apply_chunks(self, chunks, step: int) -> None:
+        """Scatter scored chunks into the device score table
+        (staleness-weighted by ``table_decay**age``, the exact in-graph
+        decay an age-0 apply would have accrued). Non-finite chunks are
+        REJECTED and counted (``sampler/chunks_rejected``): a corrupted
+        chunk (scorer_nan injection, a diverged scoring forward) must
+        never poison the sampling distribution — max(NaN, ε) semantics
+        would otherwise zero that slot's probability forever."""
+        fleet = self._scorer_fleet
+        for chunk in chunks:
+            if not np.all(np.isfinite(chunk.scores)):
+                self._chunks_rejected += 1  # graftlint: disable=GL120 -- _apply_chunks runs on the trainer thread only: the supervisor probe/restart callbacks that reach it are fit-loop calls, never the monitor thread
+                _log.warning(
+                    "rejected a non-finite score chunk (snapshot step %d) "
+                    "at step %d — table untouched", chunk.step, step)
+                continue
+            age = max(step - chunk.step, 0)
+            weight = jnp.float32(self.config.table_decay ** age)
+            new_tab = self._apply_refresh(
+                self.state.scoretable, self.state.ema.value,
+                jnp.asarray(chunk.slots), jnp.asarray(chunk.scores),
+                weight,
+            )
+            self.state = self.state.replace(scoretable=new_tab)
+            fleet.note_applied(age)
+
     def _async_refresh_tick(self, step: int, advanced: int = 1) -> None:
-        """Per-iteration fleet service: scatter every ready chunk into the
-        device score table (staleness-weighted by ``table_decay**age``,
-        the exact in-graph decay an age-0 apply would have accrued) and
-        re-snapshot the params on the ``snapshot_every`` cadence. Host
-        ints only — no device sync ever happens on this thread."""
+        """Per-iteration fleet service (ladder level 0): scatter every
+        ready chunk into the device score table and re-snapshot the
+        params on the ``snapshot_every`` cadence. Host ints only — no
+        device sync ever happens on this thread."""
         fleet = self._scorer_fleet
         if fleet is None:
+            return
+        if self.supervisor is not None and not fleet.alive():
+            # A worker died mid-interval: skip this drain (drain() would
+            # raise) — supervisor.tick() restarts the fleet or walks the
+            # ladder; queued chunks survive the restart.
             return
         chunks = fleet.drain()
         if chunks:
             with self.tracer.span("trainer/apply_refresh", cat="trainer",
                                   chunks=len(chunks)):
-                for chunk in chunks:
-                    age = max(step - chunk.step, 0)
-                    weight = jnp.float32(self.config.table_decay ** age)
-                    new_tab = self._apply_refresh(
-                        self.state.scoretable, self.state.ema.value,
-                        jnp.asarray(chunk.slots), jnp.asarray(chunk.scores),
-                        weight,
-                    )
-                    self.state = self.state.replace(scoretable=new_tab)
-                    fleet.note_applied(age)
+                self._apply_chunks(chunks, step)
         every = int(self.config.snapshot_every)
         if (step // every) > ((step - advanced) // every):
             # The identity-jit inside snapshot() copies — the live state
             # is donated into the next dispatch, so the fleet must never
             # hold its buffers.
             fleet.snapshot(self.state.params, self.state.batch_stats, step)
+
+    def _sync_refresh_tick(self, step: int, advanced: int = 1) -> None:
+        """Ladder level 1: the async fleet is gone, so the TRAINER thread
+        scores one round-robin chunk every ``supervisor_sync_every``
+        steps (``ScorerFleet.score_once`` — no worker threads involved).
+        A failure here escalates the ladder one level."""
+        fleet = self._scorer_fleet
+        every = max(int(self.config.supervisor_sync_every), 1)
+        if (step // every) <= ((step - advanced) // every):
+            return
+        try:
+            with self.tracer.span("trainer/sync_refresh", cat="trainer"):
+                # Snapshot first: level 1 has no background cadence, so
+                # the sync chunk always scores the CURRENT params.
+                fleet.snapshot(self.state.params, self.state.batch_stats,
+                               step)
+                chunk = fleet.score_once()
+        except Exception as exc:
+            self.supervisor.report_failure("sync refresh", step, exc)
+            return
+        self._apply_chunks([chunk], step)
+
+    def _make_table_flatten(self):
+        """Jitted table flatten for ladder level 3: zeroed scores make
+        ``p ∝ max(score + α·EMA_mean, ε)`` a per-row constant, so the
+        step's inverse-CDF draw IS uniform sampling — no retrace, no
+        program change, just constant table contents. Output pinned to
+        the table's committed data-axis layout (jit-cache stability)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from mercury_tpu.sampling.scoretable import ScoreTableState
+
+        sh = NamedSharding(self.mesh, P(self.config.mesh_axis))
+        return jax.jit(
+            lambda tab: tab._replace(scores=jnp.zeros_like(tab.scores)),
+            out_shardings=ScoreTableState(scores=sh, cursor=sh),
+        )
+
+    def _refresh_tick(self, step: int, advanced: int = 1) -> None:
+        """Ladder-aware refresh dispatch, called once per fit iteration.
+        Level 0 drains the async fleet; level 1 scores on the trainer
+        thread; level 2 (frozen) does nothing — the in-graph decay keeps
+        flattening the table toward the EMA mean; level 3 re-pins the
+        table to a constant EVERY iteration, making the draw uniform
+        (``sampler/is_active=0``). Per-iteration, not once: the step's
+        free write-back re-scores the trained slots in-graph (it cannot
+        be gated without a retrace), so a one-shot flatten would let S
+        of L slots re-tilt each draw — the host pin bounds that tilt to
+        the single in-flight step."""
+        sup = self.supervisor
+        level = 0 if sup is None else sup.level()
+        if level == 0:
+            self._async_refresh_tick(step, advanced)
+        elif level == 1:
+            self._sync_refresh_tick(step, advanced)
+        if sup is None:
+            return
+        if level >= 3:
+            if not hasattr(self, "_flatten_table"):
+                self._flatten_table = self._make_table_flatten()
+            self.state = self.state.replace(
+                scoretable=self._flatten_table(self.state.scoretable))
+            if self._actuated_level < 3:
+                self._actuated_level = 3
+                _log.warning(
+                    "sampler degraded to UNIFORM at step %d: score table "
+                    "flattened (sampler/is_active=0)", step)
+        elif level < 3:
+            # A recovery below uniform needs no inverse actuation: the
+            # resumed refresh path (and the in-graph EMA updates) repaint
+            # the flattened table organically.
+            self._actuated_level = level
+
+    def _probe_scoring(self) -> None:
+        """Supervisor recovery probe: one trainer-thread scoring round
+        against fresh params, applied to the table. Raises on any
+        failure (the supervisor escalates); success climbs the ladder."""
+        fleet = self._scorer_fleet
+        if fleet is None:
+            raise RuntimeError("no scorer fleet to probe")
+        step = self._host_step
+        fleet.snapshot(self.state.params, self.state.batch_stats, step)
+        chunk = fleet.score_once()
+        if not np.all(np.isfinite(chunk.scores)):
+            raise RuntimeError("probe chunk contains non-finite scores")
+        self._apply_chunks([chunk], step)
 
     # ---------------------------------------------------------- flight data
     def _flight_context(self) -> Dict[str, Any]:
@@ -920,6 +1139,12 @@ class Trainer:
         fleet = getattr(self, "_scorer_fleet", None)
         if fleet is not None:
             ctx["scorer_fleet"] = fleet.summary()
+        supervisor = getattr(self, "supervisor", None)
+        if supervisor is not None:
+            ctx["supervisor"] = supervisor.summary()
+        faults = getattr(self, "_faults", None)
+        if faults is not None:
+            ctx["faults"] = faults.summary()
         return ctx
 
     def arm_retrace_guard(self):
@@ -980,9 +1205,16 @@ class Trainer:
                 # step cadence once the dispatch queue applies
                 # backpressure — exactly the signal slow_step wants.
                 t_iter = time.perf_counter()
+                if self._faults is not None:
+                    # Advance the fault plane's step clock (workers fire
+                    # against it) and run the trainer-thread hook.
+                    self._faults.note_step(step)
+                    slow = self._faults.fire("host_slow")
+                    if slow is not None:
+                        time.sleep(float(slow.get("secs", 1.0)))
                 if self._stream_pipe is not None:
                     k = 1
-                    metrics = self._host_stream_step()
+                    metrics = self._host_stream_step(step)
                 elif self.train_step_many is not None and step + self.scan_steps <= end:
                     k = self.scan_steps
                     with self.tracer.span("trainer/dispatch",
@@ -1003,11 +1235,18 @@ class Trainer:
                             self.dataset.shard_indices,
                         )
                 step += k
+                self._host_step = step
                 if self._scorer_fleet is not None:
                     # Scatter ready async-refresh chunks and re-snapshot on
                     # cadence — host bookkeeping + async device dispatches,
-                    # nothing here waits on the step.
-                    self._async_refresh_tick(step, advanced=k)
+                    # nothing here waits on the step. Ladder-aware: a
+                    # degraded run refreshes on this thread, freezes, or
+                    # flattens to uniform (_refresh_tick).
+                    self._refresh_tick(step, advanced=k)
+                if self.supervisor is not None:
+                    # Liveness check + restarts + recovery probing —
+                    # host bookkeeping on the step cadence.
+                    self.supervisor.tick(step)
                 if self.anomaly is not None:
                     self.anomaly.observe_step_time(
                         step, time.perf_counter() - t_iter, steps=k)
@@ -1058,6 +1297,17 @@ class Trainer:
                             # Same contract: host counters only
                             # (scorer/throughput, staleness, lag).
                             record.update(self._scorer_fleet.stats())
+                            record["sampler/chunks_rejected"] = float(
+                                self._chunks_rejected)
+                        if self.supervisor is not None:
+                            # Ladder level, restarts, degradations — and
+                            # sampler/is_active (0.0 once uniform).
+                            record.update(self.supervisor.stats())
+                        if self._faults is not None:
+                            record.update(self._faults.stats())
+                        if cfg.checkpoint_dir:
+                            record["checkpoint/write_failures"] = float(
+                                ckpt.write_failures())
                         # Thread-fleet liveness (Layer C telemetry):
                         # process-wide census + the metric queue's own
                         # depth; the prefetch/scorer depths rode in with
@@ -1112,11 +1362,14 @@ class Trainer:
                             if self._ckpt_thread is not None:
                                 self._ckpt_thread.join()
                             self._ckpt_thread = ckpt.save_checkpoint_async(
-                                cfg.checkpoint_dir, self.state, step
+                                cfg.checkpoint_dir, self.state, step,
+                                failure_cb=self._ckpt_failure_cb,
+                                **self._ckpt_kwargs(),
                             )
                         else:
                             ckpt.save_checkpoint(cfg.checkpoint_dir,
-                                                 self.state, step)
+                                                 self.state, step,
+                                                 **self._ckpt_kwargs())
         finally:
             # An exception mid-loop (KeyboardInterrupt, eval error) must not
             # leave a write in flight — a relaunched auto_resume reading a
@@ -1131,8 +1384,35 @@ class Trainer:
         if not final_metrics:
             final_metrics = self.evaluate()
         if cfg.checkpoint_dir:
-            ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step)
+            ckpt.save_checkpoint(cfg.checkpoint_dir, self.state, step,
+                                 **self._ckpt_kwargs())
         return final_metrics
+
+    def _ckpt_kwargs(self) -> Dict[str, Any]:
+        """Durability knobs threaded into every cadence/final save."""
+        cfg = self.config
+        return dict(
+            keep=cfg.checkpoint_keep,
+            retries=cfg.checkpoint_write_retries,
+            retry_backoff_s=cfg.checkpoint_retry_backoff_s,
+            manifest=cfg.checkpoint_manifest,
+            faults=self._faults,
+        )
+
+    def _ckpt_failure_cb(self, exc: BaseException) -> None:
+        """Async-writer failure hook (runs ON the ckpt-write thread):
+        leave a flight record immediately — join() may be a cadence away
+        and a wedged run never joins. Never raises."""
+        try:
+            if self.anomaly is not None:
+                self.anomaly.dump_flight_record(
+                    "checkpoint_write_failed", self._host_step, {
+                        "error": f"{type(exc).__name__}: {exc}",
+                        "write_failures": ckpt.write_failures(),
+                    })
+        except Exception:
+            _log.warning("checkpoint failure flight record failed",
+                         exc_info=True)
 
     # ------------------------------------------------- profiler window
     def _start_profiler(self, steps: int) -> None:
@@ -1214,6 +1494,11 @@ class Trainer:
         if getattr(self, "_closed", False):
             return
         self._closed = True
+        supervisor = getattr(self, "supervisor", None)
+        if supervisor is not None:
+            # First: a live supervisor poll/probe must not race the unit
+            # teardown below (it would read restarts as deaths).
+            supervisor.close()
         fleet = getattr(self, "_scorer_fleet", None)
         if fleet is not None:
             fleet.close()
@@ -1372,7 +1657,9 @@ class Trainer:
     def save(self, directory: Optional[str] = None) -> str:
         directory = directory or self.config.checkpoint_dir
         assert directory, "no checkpoint directory configured"
-        return ckpt.save_checkpoint(directory, self.state, int(self.state.step))
+        return ckpt.save_checkpoint(directory, self.state,
+                                    int(self.state.step),
+                                    **self._ckpt_kwargs())
 
     def _recommit_state(self, reprime_stream: bool = False) -> None:
         """Re-place a host-resident ``self.state`` for this trainer's
@@ -1485,6 +1772,8 @@ class Trainer:
     def restore(self, directory: Optional[str] = None, step: Optional[int] = None) -> int:
         directory = directory or self.config.checkpoint_dir
         assert directory, "no checkpoint directory configured"
-        self.state, step = ckpt.restore_checkpoint(directory, self.state, step)
+        self.state, step = ckpt.restore_checkpoint(
+            directory, self.state, step,
+            verify=self.config.checkpoint_verify)
         self._recommit_state()
         return step

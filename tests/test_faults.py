@@ -1,0 +1,247 @@
+"""Fault-injection plane (``mercury_tpu/faults.py``): the spec grammar,
+the exactly-once firing semantics, and each fault kind firing at its
+production hook point (the same code paths a real death would take —
+the recovery machinery cannot tell the difference).
+
+Supervisor/ladder behavior under these faults lives in
+``test_supervisor.py``; checkpoint durability under ``ckpt_io_error``
+in ``test_checkpoint.py``."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mercury_tpu.faults import (
+    KNOWN_KINDS,
+    FaultPlane,
+    InjectedFault,
+    parse_fault_spec,
+)
+
+
+class TestSpecGrammar:
+    def test_single_entry(self):
+        (e,) = parse_fault_spec("scorer_die@step=40")
+        assert e.kind == "scorer_die"
+        assert e.step == 40 and e.every == 0 and e.args == {}
+
+    def test_params_ride_along(self):
+        (e,) = parse_fault_spec("prefetch_stall@step=10,secs=2")
+        assert e.args == {"secs": 2.0}
+
+    def test_every_and_multiple_entries(self):
+        a, b = parse_fault_spec(
+            "ckpt_io_error@step=0,every=1; scorer_die@step=5")
+        assert (a.kind, a.every) == ("ckpt_io_error", 1)
+        assert (b.kind, b.step) == ("scorer_die", 5)
+
+    def test_empty_spec_arms_nothing(self):
+        assert parse_fault_spec("") == []
+        assert FaultPlane("").stats() == {
+            "fault/injected": 0.0, "fault/armed": 0.0}
+
+    @pytest.mark.parametrize("bad,msg", [
+        ("scorer_die", "expected 'kind@step=N"),
+        ("tpu_melt@step=1", "unknown fault kind"),
+        ("scorer_die@step=soon", "not numeric"),
+        ("scorer_die@secs=2", "missing the mandatory 'step=N'"),
+        ("scorer_die@step=1,oops", "malformed param"),
+    ])
+    def test_malformed_entries_rejected(self, bad, msg):
+        with pytest.raises(ValueError, match=msg):
+            parse_fault_spec(bad)
+
+    def test_every_known_kind_parses(self):
+        for kind in KNOWN_KINDS:
+            (e,) = parse_fault_spec(f"{kind}@step=1")
+            assert e.kind == kind
+
+
+class TestFaultPlaneFiring:
+    def test_not_due_before_step(self):
+        fp = FaultPlane("scorer_die@step=5")
+        fp.note_step(4)
+        assert fp.fire("scorer_die") is None
+
+    def test_one_shot_fires_exactly_once(self):
+        fp = FaultPlane("scorer_die@step=5")
+        fp.note_step(7)   # arming is >=, not ==: workers poll late
+        assert fp.fire("scorer_die") is not None
+        assert fp.fire("scorer_die") is None
+        fp.note_step(8)
+        assert fp.fire("scorer_die") is None
+
+    def test_kind_isolation(self):
+        fp = FaultPlane("scorer_die@step=1")
+        fp.note_step(3)
+        assert fp.fire("prefetch_die") is None
+        assert fp.fire("scorer_die") is not None
+
+    def test_every_rearms_next_step_not_same_step(self):
+        """``every=1`` fires once PER STEP: a retry within the same step
+        (the checkpoint retry loop) must succeed after one injected
+        failure rather than being starved forever."""
+        fp = FaultPlane("ckpt_io_error@step=0,every=1")
+        fp.note_step(0)
+        assert fp.fire("ckpt_io_error") is not None
+        assert fp.fire("ckpt_io_error") is None   # same-step retry wins
+        fp.note_step(1)
+        assert fp.fire("ckpt_io_error") is not None
+
+    def test_every_k_cadence(self):
+        fp = FaultPlane("host_slow@step=2,every=3,secs=0")
+        fired = [s for s in range(10)
+                 if (fp.note_step(s) or fp.fire("host_slow")) is not None]
+        assert fired == [2, 5, 8]
+
+    def test_args_returned_per_firing(self):
+        fp = FaultPlane("prefetch_stall@step=0,every=1,secs=2.5")
+        fp.note_step(0)
+        assert fp.fire("prefetch_stall") == {"secs": 2.5}
+
+    def test_racing_workers_consume_once(self):
+        """N threads race fire(): the lock makes a one-shot entry fire
+        exactly once no matter who gets there first."""
+        fp = FaultPlane("scorer_die@step=1")
+        fp.note_step(1)
+        hits = []
+        barrier = threading.Barrier(8)
+
+        def worker():
+            barrier.wait()
+            got = fp.fire("scorer_die")
+            if got is not None:
+                hits.append(got)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(hits) == 1
+
+    def test_stats_count_fired_and_armed(self):
+        fp = FaultPlane("scorer_die@step=1;prefetch_die@step=9")
+        fp.note_step(1)
+        fp.fire("scorer_die")
+        assert fp.stats() == {"fault/injected": 1.0, "fault/armed": 1.0}
+        summ = fp.summary()
+        assert summ["fired_total"] == 1
+        assert {e["kind"] for e in summ["entries"]} == {
+            "scorer_die", "prefetch_die"}
+
+
+class TestPrefetchHooks:
+    """``prefetch_die`` / ``prefetch_stall`` fire inside the prefetch
+    worker's gather loop — the same loop an organic gather failure
+    kills."""
+
+    def _pipe(self, faults):
+        import jax  # noqa: F401  (mesh needs the backend up)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mercury_tpu.data.stream import HostStreamSource, PrefetchPipeline
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+
+        x = np.broadcast_to(
+            np.arange(64, dtype=np.uint8)[:, None, None], (64, 3, 2)).copy()
+        sharding = NamedSharding(host_cpu_mesh(1), P())
+        return PrefetchPipeline(
+            HostStreamSource(x), (1, 4), sharding, depth=2, faults=faults)
+
+    def test_prefetch_die_is_attributable(self):
+        fp = FaultPlane("prefetch_die@step=0")
+        fp.note_step(0)
+        pipe = self._pipe(fp)
+        try:
+            pipe.push(np.array([[0, 1, 2, 3]], np.int32))
+            with pytest.raises(RuntimeError,
+                               match="prefetch worker died") as ei:
+                pipe.pop()
+            # The poisoned item carries the worker's traceback and chains
+            # the InjectedFault as the cause — death is attributable.
+            assert "prefetch_die" in str(ei.value)
+            assert isinstance(ei.value.__cause__, InjectedFault)
+            assert not pipe.alive()
+        finally:
+            pipe.close()
+
+    def test_prefetch_stall_delays_but_delivers(self):
+        fp = FaultPlane("prefetch_stall@step=0,secs=0.2")
+        fp.note_step(0)
+        pipe = self._pipe(fp)
+        try:
+            pipe.push(np.array([[4, 5, 6, 7]], np.int32))
+            batch = pipe.pop()
+            assert np.asarray(batch).shape[1] == 4
+            assert pipe.alive()
+        finally:
+            pipe.close()
+
+
+class TestTrainerHooks:
+    """scorer_die / scorer_nan / host_slow through a real async-refresh
+    Trainer run — faults fire at the production hook points and the run
+    stays green (the apply guard / fleet liveness absorb them)."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from mercury_tpu.parallel.mesh import host_cpu_mesh
+
+        return host_cpu_mesh(4)
+
+    def _cfg(self, **kw):
+        from mercury_tpu.config import TrainConfig
+
+        base = dict(
+            model="smallcnn", dataset="synthetic", world_size=4,
+            batch_size=8, presample_batches=2, num_epochs=1,
+            steps_per_epoch=6, eval_every=0, log_every=0,
+            heartbeat_every=0, checkpoint_every=0, compute_dtype="float32",
+            seed=0, sampler="scoretable", refresh_size=8,
+            refresh_mode="async", scorer_workers=1, snapshot_every=2,
+        )
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def test_scorer_nan_chunks_rejected_not_applied(self, mesh):
+        from mercury_tpu.train.trainer import Trainer
+
+        tr = Trainer(self._cfg(fault_spec="scorer_nan@step=1,every=1"),
+                     mesh=mesh)
+        try:
+            tr.fit()
+            table = np.asarray(tr.state.scoretable.scores)
+            assert np.all(np.isfinite(table)), (
+                "a NaN chunk reached the device score table")
+            assert tr._chunks_rejected > 0
+            stats = tr._faults.stats()
+            assert stats["fault/injected"] >= 1.0
+        finally:
+            tr.close()
+
+    def test_scorer_die_without_supervisor_raises_on_drain(self, mesh):
+        """No supervisor registered: a dead scorer worker surfaces as an
+        attributable RuntimeError at the next drain — never a silent
+        stall."""
+        from mercury_tpu.train.trainer import Trainer
+
+        tr = Trainer(self._cfg(fault_spec="scorer_die@step=0"), mesh=mesh)
+        try:
+            with pytest.raises(RuntimeError, match="scorer fleet worker died"):
+                tr.fit()
+        finally:
+            tr.close()
+
+    def test_zero_cost_when_disabled(self, mesh):
+        """``fault_spec=""`` builds no plane at all — the hook sites are
+        plain attribute checks against None."""
+        from mercury_tpu.train.trainer import Trainer
+
+        tr = Trainer(self._cfg(), mesh=mesh)
+        try:
+            assert tr._faults is None
+            assert tr._scorer_fleet._faults is None
+        finally:
+            tr.close()

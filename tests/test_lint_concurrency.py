@@ -366,8 +366,10 @@ def test_manifest_known_fleet():
     doc = extract_manifest(
         [os.path.join(REPO, m) for m in HOT_THREAD_MODULES])
     names = {t["name"] for t in doc["threads"]}
-    assert {"mercury-prefetch", "mercury-metrics", "mercury-scorer-*",
-            "ckpt-write-*"} <= names
+    # mercury-prefetch* / mercury-scorer-*: supervisor restarts append
+    # -rN generation suffixes, so the declared names are wildcards.
+    assert {"mercury-prefetch*", "mercury-metrics", "mercury-scorer-*",
+            "mercury-supervisor", "ckpt-write-*"} <= names
     assert {p["prefix"] for p in doc["pools"]} == {
         "mercury-gather", "mercury-decode"}
     # the checkpoint writer is the fleet's one non-daemon thread
@@ -380,7 +382,7 @@ def test_gl125_undeclared_thread(tmp_path):
     doc = extract_manifest(
         [os.path.join(REPO, m) for m in HOT_THREAD_MODULES])
     doc["threads"] = [t for t in doc["threads"]
-                      if t["name"] != "mercury-prefetch"]
+                      if t["name"] != "mercury-prefetch*"]
     manifest = tmp_path / "m.json"
     manifest.write_text(json.dumps(doc))
     diff = tmp_path / "diff.txt"
@@ -570,7 +572,7 @@ def test_scorer_fleet_close_logs_wedged_and_stays_bounded(monkeypatch):
     """close() must return within its bound and LOG (not hang on) a
     wedged worker. The full fleet needs a model + dataset + config, so
     this drives close() on a skeletal instance — the method touches
-    only _closed and _threads."""
+    only _closed, _stop (the generation's stop event), and _threads."""
     from mercury_tpu.sampling import scorer_fleet as sf
 
     logged = []
@@ -578,6 +580,7 @@ def test_scorer_fleet_close_logs_wedged_and_stays_bounded(monkeypatch):
         sf._log, "warning", lambda msg, *a: logged.append(msg % a))
     fleet = sf.ScorerFleet.__new__(sf.ScorerFleet)
     fleet._closed = False
+    fleet._stop = threading.Event()
     release = threading.Event()
     wedged = threading.Thread(target=release.wait,
                               name="mercury-scorer-0", daemon=True)

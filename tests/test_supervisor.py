@@ -1,0 +1,393 @@
+"""HostSupervisor (``mercury_tpu/runtime/supervisor.py``): restart
+budget/backoff machinery, the degradation ladder
+async → sync → frozen → uniform, recovery probing, and the trainer
+integration — a chaos run past the restart budget must end degraded but
+GREEN with uniform sampling (``sampler/is_active=0``), and a prefetch
+restart must resume from the stream cursor bit-identically."""
+
+import time
+
+import numpy as np
+import pytest
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.parallel.mesh import host_cpu_mesh
+from mercury_tpu.runtime.supervisor import LEVEL_NAMES, HostSupervisor
+from mercury_tpu.train.trainer import Trainer
+
+
+class FakeFleet:
+    """A supervisable unit with scriptable liveness/restart behavior."""
+
+    def __init__(self, fail_restarts=0):
+        self.up = True
+        self.restarts = 0
+        self.fail_restarts = fail_restarts   # first N restarts raise
+
+    def alive(self):
+        return self.up
+
+    def restart(self):
+        if self.restarts < self.fail_restarts:
+            self.restarts += 1
+            raise RuntimeError("injected restart failure")
+        self.restarts += 1
+        self.up = True
+
+
+def make_sup(**kw):
+    base = dict(restart_budget=3, backoff_s=0.0, probe_every=0, poll_s=0.0)
+    base.update(kw)
+    return HostSupervisor(**base)
+
+
+class TestRestartMachinery:
+    def test_dead_unit_restarted_on_tick(self):
+        sup = make_sup()
+        fleet = FakeFleet()
+        sup.register_unit("scorer", fleet.alive, fleet.restart,
+                          escalates=True)
+        fleet.up = False
+        sup.tick(step=1)
+        assert fleet.up and fleet.restarts == 1
+        assert sup.stats()["supervisor/restarts"] == 1.0
+        assert sup.level() == 0
+
+    def test_units_down_gauge(self):
+        sup = make_sup(restart_budget=0)
+        fleet = FakeFleet()
+        sup.register_unit("scorer", fleet.alive, fleet.restart)
+        assert sup.stats()["supervisor/units_down"] == 0.0
+        fleet.up = False
+        sup.tick(step=1)
+        assert sup.stats()["supervisor/units_down"] == 1.0
+
+    def test_escalating_exhaustion_degrades(self):
+        sup = make_sup(restart_budget=1)
+        fleet = FakeFleet()
+        sup.register_unit("scorer", fleet.alive, fleet.restart,
+                          escalates=True)
+        fleet.up = False
+        sup.tick(step=1)          # restart 1/1
+        fleet.up = False
+        sup.tick(step=2)          # budget exhausted -> degrade to sync
+        assert sup.level() == 1
+        sup.tick(step=3)          # exhaustion latched: no degrade-per-tick
+        assert sup.level() == 1
+        assert sup.stats()["supervisor/degradations"] == 1.0
+
+    def test_non_escalating_exhaustion_stays_level0(self):
+        sup = make_sup(restart_budget=0)
+        pipe = FakeFleet()
+        sup.register_unit("prefetch", pipe.alive, pipe.restart,
+                          escalates=False)
+        pipe.up = False
+        sup.tick(step=1)
+        assert sup.level() == 0
+        assert not sup.request_restart("prefetch", step=1)
+
+    def test_request_restart_honors_budget(self):
+        sup = make_sup(restart_budget=2)
+        pipe = FakeFleet()
+        sup.register_unit("prefetch", pipe.alive, pipe.restart)
+        assert sup.request_restart("prefetch", step=1)
+        assert sup.request_restart("prefetch", step=2)
+        assert not sup.request_restart("prefetch", step=3)
+        assert pipe.restarts == 2
+        assert not sup.request_restart("unknown", step=3)
+
+    def test_failed_restart_consumes_budget(self):
+        sup = make_sup(restart_budget=1)
+        fleet = FakeFleet(fail_restarts=5)
+        sup.register_unit("scorer", fleet.alive, fleet.restart,
+                          escalates=True)
+        fleet.up = False
+        sup.tick(step=1)          # restart attempt raises
+        assert not fleet.up
+        fleet.up = False
+        sup.tick(step=2)          # budget gone -> ladder
+        assert sup.level() == 1
+
+
+class TestDegradationLadder:
+    def test_ladder_order_is_exact(self):
+        sup = make_sup()
+        seen = [sup.level_name()]
+        for i in range(4):
+            sup.report_failure("test", step=i, exc=RuntimeError("x"))
+            seen.append(sup.level_name())
+        assert seen == ["async", "sync", "frozen", "uniform", "uniform"]
+        assert LEVEL_NAMES == ("async", "sync", "frozen", "uniform")
+
+    def test_uniform_flips_sampler_inactive(self):
+        sup = make_sup()
+        for i in range(3):
+            assert sup.sampler_active()
+            sup.report_failure("test", step=i, exc=RuntimeError("x"))
+        assert not sup.sampler_active()
+        assert sup.stats()["sampler/is_active"] == 0.0
+        assert sup.stats()["supervisor/level"] == 3.0
+
+    def test_probe_success_climbs_and_final_climb_revives(self):
+        sup = make_sup(probe_every=1)
+        calls = []
+        sup.set_ladder(probe=lambda: calls.append("probe"),
+                       revive=lambda: calls.append("revive"))
+        sup.report_failure("a", 0, RuntimeError("x"))
+        sup.report_failure("b", 0, RuntimeError("x"))
+        assert sup.level() == 2
+        sup.tick(step=1)                   # probe ok -> frozen -> sync
+        assert sup.level() == 1
+        assert calls == ["probe"]
+        sup.tick(step=2)                   # revive + probe -> async
+        assert sup.level() == 0
+        assert calls == ["probe", "revive", "probe"]
+        assert sup.stats()["supervisor/recoveries"] == 2.0
+
+    def test_probe_failure_escalates(self):
+        def bad_probe():
+            raise RuntimeError("still broken")
+
+        sup = make_sup(probe_every=1)
+        sup.set_ladder(probe=bad_probe, revive=lambda: None)
+        sup.report_failure("a", 0, RuntimeError("x"))
+        sup.tick(step=1)
+        assert sup.level() == 2
+        sup.tick(step=2)
+        assert sup.level() == 3
+        sup.tick(step=3)                   # already uniform: stays
+        assert sup.level() == 3
+
+    def test_recovery_to_nominal_resets_escalating_budgets(self):
+        sup = make_sup(restart_budget=1, probe_every=1)
+        fleet = FakeFleet()
+        sup.register_unit("scorer", fleet.alive, fleet.restart,
+                          escalates=True)
+        sup.set_ladder(probe=lambda: None, revive=lambda: None)
+        fleet.up = False
+        sup.tick(step=1)                   # uses the whole budget
+        fleet.up = False
+        # Exhausted -> sync; the same tick's probe succeeds -> back to
+        # async WITH the escalating budgets reset.
+        sup.tick(step=2)
+        assert sup.level() == 0
+        assert sup.stats()["supervisor/degradations"] == 1.0
+        assert sup.stats()["supervisor/recoveries"] == 1.0
+        # The fleet is still down: the fresh budget restarts it again.
+        sup.tick(step=3)
+        assert fleet.up
+        assert sup.level() == 0
+
+    def test_transitions_recorded(self):
+        sup = make_sup()
+        sup.report_failure("sync refresh", 7, RuntimeError("x"))
+        summ = sup.summary()
+        assert summ["level_name"] == "sync"
+        (t,) = summ["transitions"]
+        assert (t["from"], t["to"], t["step"]) == ("async", "sync", 7)
+        assert "sync refresh" in t["reason"]
+
+    def test_stats_keys_registered(self):
+        from mercury_tpu.obs.registry import METRIC_KEYS
+
+        sup = make_sup()
+        assert set(sup.stats()) <= set(METRIC_KEYS)
+
+
+class TestMonitorThread:
+    def test_poll_thread_lifecycle(self):
+        sup = HostSupervisor(poll_s=0.01)
+        fleet = FakeFleet()
+        sup.register_unit("scorer", fleet.alive, fleet.restart)
+        assert sup._thread is not None
+        assert sup._thread.name == "mercury-supervisor"
+        assert sup._thread.daemon
+        fleet.up = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if sup.summary()["units"][0]["down"]:
+                break
+            time.sleep(0.01)
+        # The monitor only STAMPS the death — restarts stay on tick().
+        assert sup.summary()["units"][0]["down"]
+        assert fleet.restarts == 0
+        sup.close()
+        sup.close()                        # idempotent
+        assert not sup._thread.is_alive()
+
+    def test_no_thread_when_poll_disabled(self):
+        sup = make_sup(poll_s=0.0)
+        assert sup._thread is None
+        sup.close()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return host_cpu_mesh(4)
+
+
+def sup_cfg(**kw):
+    base = dict(
+        model="smallcnn", dataset="synthetic", world_size=4, batch_size=8,
+        presample_batches=2, num_epochs=1, steps_per_epoch=6, eval_every=0,
+        log_every=0, heartbeat_every=0, checkpoint_every=0,
+        compute_dtype="float32", seed=0, supervise=True,
+        supervisor_backoff_s=0.0,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def async_kw():
+    return dict(sampler="scoretable", refresh_size=8, refresh_mode="async",
+                scorer_workers=1, snapshot_every=2)
+
+
+class TestTrainerIntegration:
+    def test_scorer_death_restarted_within_budget(self, mesh):
+        """A one-shot scorer death is restarted by tick() and the run
+        stays at ladder level 0 with a generation-bumped fleet."""
+        tr = Trainer(sup_cfg(fault_spec="scorer_die@step=1", **async_kw()),
+                     mesh=mesh)
+        try:
+            tr._faults.note_step(1)
+            deadline = time.monotonic() + 20.0
+            while tr._scorer_fleet.alive() and time.monotonic() < deadline:
+                tr._scorer_fleet.drain()   # unblock a queue-parked worker
+                time.sleep(0.01)
+            assert not tr._scorer_fleet.alive()
+            tr.supervisor.tick(step=2)
+            assert tr._scorer_fleet.alive()
+            assert tr._scorer_fleet.summary()["generation"] == 1
+            stats = tr.supervisor.stats()
+            assert stats["supervisor/restarts"] == 1.0
+            assert stats["supervisor/level"] == 0.0
+            # -rN thread names: the restarted fleet is distinguishable in
+            # the thread census (lint Layer C wildcards cover them).
+            assert any(t.name.endswith("-r1")
+                       for t in tr._scorer_fleet._threads)
+        finally:
+            tr.close()
+
+    def test_chaos_past_budget_ends_uniform(self, mesh):
+        """The acceptance run: a persistent scorer fault past the restart
+        budget walks the full ladder and the run ends GREEN with uniform
+        sampling — sampler/is_active=0 and a constant score table.
+
+        Budget 0 keeps the walk deterministic: one worker death exhausts
+        it (detection is the only async dependency — host_slow paces the
+        loop so a parked worker always gets its firing window), and every
+        later descent (sync-refresh failure, probe failure) happens ON
+        the trainer thread. TWO concurrent scorer_die schedules: a step
+        has one firing per entry, so the dying worker consuming one can
+        never starve the trainer-thread probe of its failure — the
+        recovery probe must keep FAILING for the ladder to descend."""
+        tr = Trainer(sup_cfg(
+            fault_spec=("scorer_die@step=1,every=1;"
+                        "scorer_die@step=1,every=1;"
+                        "host_slow@step=1,every=1,secs=0.02"),
+            supervisor_restart_budget=0, supervisor_probe_every=1,
+            supervisor_sync_every=1, steps_per_epoch=60,
+            **async_kw()), mesh=mesh)
+        try:
+            tr.fit()                       # must not raise: degraded, green
+            stats = tr.supervisor.stats()
+            assert stats["supervisor/level"] == 3.0, tr.supervisor.summary()
+            assert stats["sampler/is_active"] == 0.0
+            assert stats["supervisor/degradations"] >= 3.0
+            # The per-iteration level-3 pin leaves the table CONSTANT at
+            # exit (zeroed scores), so the next inverse-CDF draw would be
+            # exactly uniform.
+            table = np.asarray(tr.state.scoretable.scores)
+            assert np.all(np.isfinite(table))
+            assert np.all(table == table.flat[0])
+            assert tr._actuated_level == 3
+            names = [t["to"] for t in tr.supervisor.summary()["transitions"]]
+            assert names[-3:] == ["sync", "frozen", "uniform"] or \
+                "uniform" in names
+        finally:
+            tr.close()
+
+    def test_prefetch_restart_resumes_bitwise(self, mesh):
+        """Prefetch death mid-run: the supervisor rebuilds the pipeline
+        from the stream cursor, and the trajectory is BIT-identical to an
+        uninterrupted run — no sample skipped or duplicated."""
+        kw = dict(data_placement="host_stream", prefetch_depth=2,
+                  batch_size=4, steps_per_epoch=8)
+        ref = Trainer(sup_cfg(supervise=False, **kw), mesh=mesh)
+        try:
+            ref.fit()
+            ref_params = [np.asarray(x) for x in
+                          __import__("jax").tree_util.tree_leaves(
+                              ref.state.params)]
+        finally:
+            ref.close()
+
+        tr = Trainer(sup_cfg(fault_spec="prefetch_die@step=2", **kw),
+                     mesh=mesh)
+        try:
+            tr.fit()
+            stats = tr.supervisor.stats()
+            assert stats["supervisor/restarts"] >= 1.0, (
+                "the injected prefetch death was never restarted")
+            assert tr._stream_gen >= 1
+            got = [np.asarray(x) for x in
+                   __import__("jax").tree_util.tree_leaves(tr.state.params)]
+            for a, b in zip(ref_params, got):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            tr.close()
+
+    def test_prefetch_budget_exhaustion_propagates(self, mesh):
+        """escalates=False: past the budget a prefetch death is terminal
+        — training cannot proceed without input, so fit() raises
+        attributably instead of degrading."""
+        tr = Trainer(sup_cfg(
+            data_placement="host_stream", prefetch_depth=2, batch_size=4,
+            steps_per_epoch=8, supervisor_restart_budget=0,
+            fault_spec="prefetch_die@step=2"), mesh=mesh)
+        try:
+            with pytest.raises(RuntimeError, match="prefetch worker died"):
+                tr.fit()
+        finally:
+            tr.close()
+
+
+@pytest.mark.slow
+class TestChaosMatrix:
+    def test_concurrent_fault_matrix_stays_green(self, mesh, tmp_path):
+        """The chaos CI scenario as a test: host_stream input + async
+        scorer fleet + cadence checkpoints under four concurrent fault
+        kinds. The run must complete, telemetry must account for every
+        injection, and the final checkpoint must restore verified."""
+        from mercury_tpu.train import checkpoint as ckpt
+
+        before_failures = ckpt.write_failures()
+        tr = Trainer(sup_cfg(
+            data_placement="host_stream", prefetch_depth=2, batch_size=4,
+            steps_per_epoch=24, log_every=6,
+            checkpoint_dir=str(tmp_path), checkpoint_every=8,
+            checkpoint_write_retries=2, checkpoint_retry_backoff_s=0.01,
+            supervisor_restart_budget=2, supervisor_probe_every=4,
+            supervisor_sync_every=2,
+            fault_spec=("scorer_die@step=3,every=6;"
+                        "prefetch_stall@step=2,every=5,secs=0.05;"
+                        "ckpt_io_error@step=4,every=2;"
+                        "sink_wedge@step=5,secs=0.05;"
+                        "host_slow@step=6,secs=0.01"),
+            **async_kw()), mesh=mesh)
+        try:
+            tr.fit()                       # degraded-but-green contract
+            assert tr._faults.stats()["fault/injected"] >= 4.0
+            # Every param finite; the sampler may be at any ladder level.
+            for leaf in __import__("jax").tree_util.tree_leaves(
+                    tr.state.params):
+                assert np.all(np.isfinite(np.asarray(leaf)))
+            # ckpt_io_error fired at least once on a cadence write and the
+            # retry loop absorbed it (counted, not fatal).
+            assert ckpt.write_failures() > before_failures
+            restored, step = ckpt.restore_checkpoint(
+                str(tmp_path), tr.state, verify=True)
+            assert step >= 8
+        finally:
+            tr.close()
