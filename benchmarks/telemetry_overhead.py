@@ -53,7 +53,8 @@ BASE_KEYS = {"train/loss", "train/acc", "train/pool_loss",
              "train/sparse_rate", "train/moe_aux"}
 
 
-def build(telemetry: bool, args):
+def build(telemetry: bool, args, sampler: str = None,
+          variance_probe_every: int = 0):
     from mercury_tpu.config import TrainConfig
     from mercury_tpu.parallel.mesh import make_mesh
     from mercury_tpu.train.trainer import Trainer
@@ -64,7 +65,7 @@ def build(telemetry: bool, args):
         world_size=args.world,
         batch_size=args.batch,
         presample_batches=3,
-        sampler=args.sampler,
+        sampler=sampler or args.sampler,
         num_epochs=1,
         steps_per_epoch=10_000,
         eval_every=0,
@@ -72,6 +73,7 @@ def build(telemetry: bool, args):
         scan_steps=1,
         compute_dtype="float32",
         telemetry=telemetry,
+        variance_probe_every=variance_probe_every,
         heartbeat_every=0,
         seed=0,
     )
@@ -157,6 +159,12 @@ def main(argv=None) -> int:
                     help="steps per timed block")
     ap.add_argument("--rounds", type=int, default=7,
                     help="interleaved on/off block pairs; medians reported")
+    ap.add_argument("--probe-every", type=int, default=4,
+                    help="variance_probe_every for the distribution arm "
+                         "(amortized: one extra scoring forward per K "
+                         "steps)")
+    ap.add_argument("--no-dist", action="store_true",
+                    help="skip the scoretable histogram+ledger+probe arm")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "results_telemetry_overhead.jsonl"))
     args = ap.parse_args(argv)
@@ -165,9 +173,22 @@ def main(argv=None) -> int:
 
     on = Arm(build(True, args))
     off = Arm(build(False, args))
+    # Distribution-telemetry arm: scoretable sampler with the full
+    # sampler_dist surface on (score/weight histograms, selection-count
+    # ledger scatter, grad-variance probe every K steps) vs the SAME
+    # sampler with telemetry off — isolating the histogram+ledger+probe
+    # cost from the scoretable's own cost. Same 2% budget.
+    dist_on = dist_off = None
+    if not args.no_dist:
+        dist_on = Arm(build(True, args, sampler="scoretable",
+                            variance_probe_every=args.probe_every))
+        dist_off = Arm(build(False, args, sampler="scoretable"))
     for _ in range(args.rounds):
         on.run_block(args.calls)
         off.run_block(args.calls)
+        if dist_on is not None:
+            dist_on.run_block(args.calls)
+            dist_off.run_block(args.calls)
 
     # Compile-away proof: the off switch restores the seed's exact metric
     # surface and a strictly smaller program than telemetry-on.
@@ -175,6 +196,22 @@ def main(argv=None) -> int:
     assert set(on.metric_keys) > BASE_KEYS, on.metric_keys
     assert off.lowered_lines < on.lowered_lines, (
         off.lowered_lines, on.lowered_lines)
+    if dist_on is not None:
+        from mercury_tpu.obs.sampler_health import hist_keys
+
+        dist_keys = set(dist_on.metric_keys)
+        assert set(hist_keys("score_hist")) <= dist_keys, dist_keys
+        assert set(hist_keys("w_hist")) <= dist_keys, dist_keys
+        # --probe-every 0 isolates the always-on histogram+ledger cost
+        # (the 2% budget's subject); the probe is a separately-cadenced
+        # opt-in whose cost amortizes as 1/K.
+        if args.probe_every > 0:
+            assert "sampler_dist/var_ratio" in dist_keys, dist_keys
+        # telemetry=False on the scoretable arm compiles every
+        # sampler_dist emitter (and the ledger itself) away.
+        assert set(dist_off.metric_keys) == BASE_KEYS, dist_off.metric_keys
+        assert dist_off.lowered_lines < dist_on.lowered_lines, (
+            dist_off.lowered_lines, dist_on.lowered_lines)
 
     overhead_pct = 100.0 * (off.steps_per_s / on.steps_per_s - 1.0)
     tracer_cost = measure_tracer()
@@ -201,6 +238,19 @@ def main(argv=None) -> int:
         "off_lowered_sha256": off.lowered_sha256,
         **tracer_cost,
     }
+    if dist_on is not None:
+        dist_overhead_pct = 100.0 * (dist_off.steps_per_s
+                                     / dist_on.steps_per_s - 1.0)
+        record.update({
+            "dist_probe_every": args.probe_every,
+            "dist_on_steps_per_s": round(dist_on.steps_per_s, 3),
+            "dist_off_steps_per_s": round(dist_off.steps_per_s, 3),
+            "dist_overhead_pct": round(dist_overhead_pct, 2),
+            "dist_on_metric_key_count": len(dist_on.metric_keys),
+            "dist_on_lowered_lines": dist_on.lowered_lines,
+            "dist_off_lowered_lines": dist_off.lowered_lines,
+            "dist_off_lowered_sha256": dist_off.lowered_sha256,
+        })
     with open(args.out, "a") as f:
         f.write(json.dumps(record) + "\n")
     print(json.dumps(record, indent=2))
@@ -209,6 +259,10 @@ def main(argv=None) -> int:
               "the 2% budget on this host (CPU timing is noisy — rerun "
               "with more --calls before reading much into it)",
               file=sys.stderr)
+    if dist_on is not None and record["dist_overhead_pct"] > 2.0:
+        print(f"# WARNING: sampler_dist overhead "
+              f"{record['dist_overhead_pct']:.2f}% exceeds the 2% budget "
+              "on this host (same CPU-noise caveat)", file=sys.stderr)
     return 0
 
 

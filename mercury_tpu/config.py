@@ -288,6 +288,17 @@ class TrainConfig:
     # program (the jaxpr is identical to the seed step; verified by
     # benchmarks/telemetry_overhead.py).
     telemetry: bool = True
+    # In-graph grad-variance probe (obs/sampler_health.py): every K-th
+    # step run ONE extra scoring-model microbatch pass over the trained
+    # batch and emit sampler_dist/var_ratio — the estimated IS-vs-uniform
+    # gradient second-moment ratio (the 1803.00942 gate signal; < 1 means
+    # importance sampling is beating uniform). Observe-only. Requires
+    # telemetry=True and scan_steps == 1; set K to a multiple of
+    # log_every so the probe lands on logged records (non-probe steps
+    # carry the -1.0 sentinel, which every consumer ignores). 0 disables
+    # — and the probe is trace-time-gated, so the compiled program is
+    # untouched when off.
+    variance_probe_every: int = 0
     # Stdout heartbeat cadence (steps) for the async metric writer's
     # rate-limited one-line progress print; 0 disables the heartbeat.
     # Independent of log_every: metrics stream to JSONL/TensorBoard every
@@ -343,6 +354,25 @@ class TrainConfig:
     # per log interval (benchmarks budget is 0.10 steady-state; 0.25
     # flags a sustained 2.5x breach). 0 disables.
     slo_stall_frac_max: float = 0.25
+    # Selection-collapse ceiling on sampler_dist/gini (the selection
+    # -count ledger's Gini, 0 = uniform coverage, →1 = all draws on a
+    # vanishing slice): above it the `selection_collapse` trigger fires
+    # the flight recorder with the live histograms attached. 0 disables.
+    # Note a healthy importance sampler is deliberately non-uniform —
+    # arm this well above the run's steady-state Gini.
+    slo_selection_gini_max: float = 0.0
+    # Per-class starvation floor: a class whose share of draws falls
+    # below this fraction of its share of the data counts as starved
+    # (sampler_dist/class_starved), and any starved class fires the
+    # `class_starvation` trigger. Also the monitor's starvation
+    # definition when triggers are disarmed. 0 disables the trigger
+    # (the monitor then uses its 0.2 default for the metric).
+    slo_class_starvation_share: float = 0.0
+    # `is_losing` patience: consecutive LOGGED probe records with
+    # sampler_dist/var_ratio >= 1 (IS not beating uniform) before the
+    # trigger fires. Needs variance_probe_every > 0 to mean anything.
+    # 0 disables.
+    slo_var_ratio_patience: int = 0
     # --- cross-host telemetry (obs/aggregate.py): merge per-host metric
     # shards into host/{min,max,spread}/* + host/straggler_ratio on
     # host 0's records. "auto" → "files" when process_count > 1, off

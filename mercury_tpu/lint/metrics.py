@@ -33,8 +33,8 @@ from typing import Dict, List, Set, Tuple
 #: matching this shape in package source is treated as an emitted metric
 #: key and checked against the registry.
 KEY_RE = re.compile(
-    r"^(train|test|sampler|perf|time|data|obs|anomaly|host|prof|scorer"
-    r"|threads|lint|fault|supervisor|checkpoint)"
+    r"^(train|test|sampler|sampler_dist|perf|time|data|obs|anomaly|host"
+    r"|prof|scorer|threads|lint|fault|supervisor|checkpoint)"
     r"/[a-z0-9_]+(/[a-z0-9_]+)?$")
 
 #: Backticked tokens in the docs, brace families included

@@ -26,6 +26,22 @@ trigger fires:
   ``obs/aggregate.py``) exceeded its factor: one host is pacing the
   whole pod. Needs cross-host telemetry, so it can only fire in
   multi-process runs (or tests that synthesize shards).
+- **selection_collapse** — the selection-count ledger's Gini
+  coefficient (``sampler_dist/gini``, :mod:`mercury_tpu.obs.sampler_health`)
+  exceeded its ceiling: the sampler is hammering a narrow slice of the
+  dataset and coverage of the rest has stalled. The dump's detail
+  carries the latest score/weight histograms so the shape of the
+  distribution at collapse time survives the post-mortem.
+- **class_starvation** — ``sampler_dist/class_starved`` reported one
+  or more classes whose selection share fell below the starvation
+  floor relative to their data share: the sampler has effectively
+  dropped part of the label space.
+- **is_losing** — ``sampler_dist/var_ratio`` (the periodic grad-variance
+  probe; arXiv:1803.00942's gate signal) stayed >= 1 for
+  ``var_ratio_patience`` consecutive probe records: importance sampling
+  is not reducing gradient variance versus uniform and is costing its
+  overhead for nothing. Off-cadence sentinel records (ratio < 0) are
+  skipped, not counted as recovery.
 
 On trigger the engine dumps the flight record (ring, spans, config,
 manifest, pipeline/pending-selection summary, device memory stats) and —
@@ -81,6 +97,17 @@ def device_memory_stats() -> Dict[str, Dict[str, int]]:
     return out
 
 
+def _sampler_histograms(record: Dict[str, float]) -> Dict[str, float]:
+    """The record's per-bin sampler histogram keys, for attaching the
+    offending distribution to a sampler-health flight record."""
+    return {
+        k: record[k]
+        for k in sorted(record)
+        if k.startswith("sampler_dist/score_hist/")
+        or k.startswith("sampler_dist/w_hist/")
+    }
+
+
 class AnomalyEngine:
     """Continuous health evaluation + flight-record dumps.
 
@@ -110,6 +137,9 @@ class AnomalyEngine:
         stall_frac_max: float = 0.0,
         mfu_floor: float = 0.0,
         straggler_factor: float = 0.0,
+        gini_max: float = 0.0,
+        starved_classes: float = 0.0,
+        var_ratio_patience: int = 0,
         cooldown_steps: int = 200,
         max_dumps: int = 8,
         dump_dir: Optional[str] = None,
@@ -125,6 +155,9 @@ class AnomalyEngine:
         self.stall_frac_max = float(stall_frac_max)
         self.mfu_floor = float(mfu_floor)
         self.straggler_factor = float(straggler_factor)
+        self.gini_max = float(gini_max)
+        self.starved_classes = float(starved_classes)
+        self.var_ratio_patience = int(var_ratio_patience)
         self.cooldown_steps = int(cooldown_steps)
         self.max_dumps = int(max_dumps)
         self.dump_dir = dump_dir
@@ -145,6 +178,11 @@ class AnomalyEngine:
 
         # Stall-fraction state (drain thread only).
         self._prev_record_time: Optional[float] = None
+
+        # is_losing state (drain thread only): consecutive logged probe
+        # records with var_ratio >= 1. Sentinel records (< 0, probe off
+        # cadence) neither count nor reset; a genuine < 1 record resets.
+        self._var_ratio_breaches = 0
 
         # Profiler arming (set under the lock, consumed by the trainer).
         self._profile_pending = 0
@@ -242,6 +280,44 @@ class AnomalyEngine:
                 if key in record:
                     detail[key] = record[key]
             self._trigger("straggler", step, detail)
+
+        gini = record.get("sampler_dist/gini")
+        if self.gini_max > 0 and gini is not None and gini > self.gini_max:
+            detail = {"gini": gini, "ceiling": self.gini_max}
+            cov = record.get("sampler_dist/frac_never_selected")
+            if cov is not None:
+                detail["frac_never_selected"] = cov
+            detail.update(_sampler_histograms(record))
+            self._trigger("selection_collapse", step, detail)
+
+        starved = record.get("sampler_dist/class_starved")
+        if (self.starved_classes > 0 and starved is not None
+                and starved >= self.starved_classes):
+            detail = {"class_starved": starved,
+                      "threshold": self.starved_classes}
+            for key in ("sampler_dist/class_share_min",
+                        "sampler_dist/class_share_max"):
+                if key in record:
+                    detail[key] = record[key]
+            detail.update(_sampler_histograms(record))
+            self._trigger("class_starvation", step, detail)
+
+        ratio = record.get("sampler_dist/var_ratio")
+        if self.var_ratio_patience > 0 and ratio is not None:
+            # ratio < 0 is the off-cadence sentinel: no probe ran this
+            # record, so it carries no evidence either way.
+            if ratio >= 1.0:
+                self._var_ratio_breaches += 1
+                if self._var_ratio_breaches >= self.var_ratio_patience:
+                    detail = {"var_ratio": ratio,
+                              "consecutive_breaches":
+                                  self._var_ratio_breaches,
+                              "patience": self.var_ratio_patience}
+                    detail.update(_sampler_histograms(record))
+                    self._var_ratio_breaches = 0
+                    self._trigger("is_losing", step, detail)
+            elif ratio >= 0.0:
+                self._var_ratio_breaches = 0
 
         with self._lock:
             triggers = self.triggers

@@ -48,6 +48,65 @@ METRIC_KEYS: Dict[str, str] = {
     "sampler/is_active":
         "1 while importance sampling drives the draw; 0 once degraded "
         "to uniform (supervisor ladder level 3)",
+    # sampler_dist/* — distribution-level sampler health
+    # (obs/sampler_health.py). The in-graph half (histogram bins,
+    # var_ratio) exists only under telemetry=True with the scoretable
+    # sampler; the host-side half (coverage, gini, class spread, bias
+    # audit) is derived from the selection-count ledger at the log gate
+    # by SamplerHealthMonitor (single-controller runs).
+    "sampler_dist/var_ratio":
+        "grad-variance probe: IS/uniform grad-norm second-moment ratio "
+        "(>= 1 means IS is losing; -1 on off-cadence steps)",
+    "sampler_dist/frac_never_selected":
+        "fraction of the dataset never drawn for training so far",
+    "sampler_dist/gini":
+        "Gini coefficient of per-sample selection counts (0 uniform)",
+    "sampler_dist/class_share_min":
+        "smallest per-class selection share over data share",
+    "sampler_dist/class_share_max":
+        "largest per-class selection share over data share",
+    "sampler_dist/class_starved":
+        "classes whose selection/data share ratio is below the floor",
+    "sampler_dist/bias_chi2":
+        "chi-square-per-slot drift of observed draws vs table probs",
+    "sampler_dist/bias_ok":
+        "1 while the inclusion-bias audit is within threshold, else 0",
+    # score-table histogram, 16 log-spaced bins over [1e-6, 1e2);
+    # under/overflow clamps into the end bins (counts total the table)
+    "sampler_dist/score_hist/b00": "score-table histogram bin 0 count",
+    "sampler_dist/score_hist/b01": "score-table histogram bin 1 count",
+    "sampler_dist/score_hist/b02": "score-table histogram bin 2 count",
+    "sampler_dist/score_hist/b03": "score-table histogram bin 3 count",
+    "sampler_dist/score_hist/b04": "score-table histogram bin 4 count",
+    "sampler_dist/score_hist/b05": "score-table histogram bin 5 count",
+    "sampler_dist/score_hist/b06": "score-table histogram bin 6 count",
+    "sampler_dist/score_hist/b07": "score-table histogram bin 7 count",
+    "sampler_dist/score_hist/b08": "score-table histogram bin 8 count",
+    "sampler_dist/score_hist/b09": "score-table histogram bin 9 count",
+    "sampler_dist/score_hist/b10": "score-table histogram bin 10 count",
+    "sampler_dist/score_hist/b11": "score-table histogram bin 11 count",
+    "sampler_dist/score_hist/b12": "score-table histogram bin 12 count",
+    "sampler_dist/score_hist/b13": "score-table histogram bin 13 count",
+    "sampler_dist/score_hist/b14": "score-table histogram bin 14 count",
+    "sampler_dist/score_hist/b15": "score-table histogram bin 15 count",
+    # per-batch IS-weight (scaled_probs) histogram, 16 log-spaced bins
+    # over [1e-4, 1e4); 1.0 is the uniform weight
+    "sampler_dist/w_hist/b00": "IS-weight histogram bin 0 count",
+    "sampler_dist/w_hist/b01": "IS-weight histogram bin 1 count",
+    "sampler_dist/w_hist/b02": "IS-weight histogram bin 2 count",
+    "sampler_dist/w_hist/b03": "IS-weight histogram bin 3 count",
+    "sampler_dist/w_hist/b04": "IS-weight histogram bin 4 count",
+    "sampler_dist/w_hist/b05": "IS-weight histogram bin 5 count",
+    "sampler_dist/w_hist/b06": "IS-weight histogram bin 6 count",
+    "sampler_dist/w_hist/b07": "IS-weight histogram bin 7 count",
+    "sampler_dist/w_hist/b08": "IS-weight histogram bin 8 count",
+    "sampler_dist/w_hist/b09": "IS-weight histogram bin 9 count",
+    "sampler_dist/w_hist/b10": "IS-weight histogram bin 10 count",
+    "sampler_dist/w_hist/b11": "IS-weight histogram bin 11 count",
+    "sampler_dist/w_hist/b12": "IS-weight histogram bin 12 count",
+    "sampler_dist/w_hist/b13": "IS-weight histogram bin 13 count",
+    "sampler_dist/w_hist/b14": "IS-weight histogram bin 14 count",
+    "sampler_dist/w_hist/b15": "IS-weight histogram bin 15 count",
     # perf/* — throughput accounting between log ticks
     "perf/steps_per_s": "steps per second since the previous log tick",
     "perf/examples_per_s": "examples per second since the previous log tick",

@@ -153,6 +153,93 @@ def comparison_value(records: Sequence[Dict[str, Any]], key: str,
     return None if s is None else s["mean_tail"]
 
 
+# ------------------------------------------------- sampler-health section
+#: Bin count of the in-graph histograms (obs/sampler_health.HIST_BINS —
+#: mirrored literally: this module must import nothing from the package).
+_HIST_BINS = 16
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: Sequence[float]) -> str:
+    """Pure-stdlib twin of ``obs.sampler_health.sparkline`` (that one is
+    numpy; this module renders on machines with nothing installed)."""
+    top = max(values) if values else 0.0
+    if top <= 0:
+        return _SPARK_BLOCKS[0] * len(values)
+    hi = len(_SPARK_BLOCKS) - 1
+    return "".join(_SPARK_BLOCKS[min(int(v / top * hi), hi)]
+                   for v in values)
+
+
+def _hist_last(records: Sequence[Dict[str, Any]], family: str
+               ) -> Tuple[Optional[List[float]], Optional[int]]:
+    """Latest complete per-bin histogram of ``family``, newest first."""
+    keys = [f"sampler_dist/{family}/b{i:02d}" for i in range(_HIST_BINS)]
+    for rec in reversed(records):
+        if all(isinstance(rec.get(k), (int, float)) for k in keys):
+            return [float(rec[k]) for k in keys], int(rec.get("step", -1))
+    return None, None
+
+
+def _sampler_health_blocks(records: Sequence[Dict[str, Any]]
+                           ) -> List[Block]:
+    """The "Sampler health" section: histogram sparklines, the ledger's
+    coverage table, the grad-variance probe summary and the
+    inclusion-bias verdict. Empty when the run emitted no
+    ``sampler_dist/*`` keys (uniform baseline, telemetry off)."""
+    blocks: List[Block] = []
+    hist_rows = []
+    for family, label, span in (
+            ("score_hist", "score table", "[1e-6, 1e2)"),
+            ("w_hist", "IS weights (L·p)", "[1e-4, 1e4)")):
+        counts, step = _hist_last(records, family)
+        if counts is not None:
+            hist_rows.append([label, _sparkline(counts),
+                              int(sum(counts)), span, step])
+    cov = []
+    for key, label in (
+            ("sampler_dist/frac_never_selected", "never selected"),
+            ("sampler_dist/gini", "selection Gini"),
+            ("sampler_dist/class_share_min", "class share min"),
+            ("sampler_dist/class_share_max", "class share max"),
+            ("sampler_dist/class_starved", "classes starved")):
+        s = summarize_metric(records, key)
+        if s is not None:
+            cov.append([label, _fmt(s["last"]), _fmt(s["min"]),
+                        _fmt(s["max"])])
+    probes = [v for v in metric_series(records, "sampler_dist/var_ratio")
+              if v >= 0.0]  # -1.0 == off-cadence sentinel
+    chi2 = summarize_metric(records, "sampler_dist/bias_chi2")
+    ok = summarize_metric(records, "sampler_dist/bias_ok")
+    if not (hist_rows or cov or probes or chi2):
+        return blocks
+    blocks.append(("h", 2, "Sampler health"))
+    if hist_rows:
+        blocks.append(("table",
+                       ["distribution", "histogram (log bins)", "count",
+                        "range", "step"], hist_rows))
+    if cov:
+        blocks.append(("table",
+                       ["coverage", "last", "min", "max"], cov))
+    if probes:
+        losing = sum(1 for v in probes if v >= 1.0)
+        blocks.append(("kv", [
+            ("variance probe (last)", probes[-1]),
+            ("probe records", len(probes)),
+            ("probes with IS losing (ratio ≥ 1)",
+             f"{losing}/{len(probes)}")]))
+    if chi2 is not None:
+        verdict = "UNKNOWN"
+        if ok is not None:
+            verdict = ("within threshold" if ok["last"] >= 1.0
+                       else "BIASED — draws drifted from table probs")
+        blocks.append(("kv", [
+            ("inclusion-bias χ²/slot (last)", chi2["last"]),
+            ("bias-audit verdict", verdict)]))
+    return blocks
+
+
 # ------------------------------------------------------------ rendering
 # Reports are built as a neutral block list so markdown and HTML render
 # from the same structure: ("h", level, text) | ("p", text) |
@@ -196,6 +283,7 @@ def _run_blocks(run: Dict[str, Any]) -> List[Block]:
         blocks.append(("table",
                        ["metric", "last", f"mean(last {_DEFAULT_WINDOW})",
                         "min", "max", "n"], rows))
+        blocks.extend(_sampler_health_blocks(records))
     if run["shards"]:
         blocks.append(("h", 2, "Per-host shards"))
         rows = []

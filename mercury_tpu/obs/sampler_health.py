@@ -1,0 +1,296 @@
+"""Distribution-level sampler observability (the sensory half of the
+self-tuning sampler control plane, ROADMAP item 3).
+
+The scalar telemetry (``sampler/ess``, ``clip_frac``, table ages) sees the
+importance sampler only through moments; this module sees the
+*distributions*:
+
+- :func:`log_bin_histogram` — fixed log-spaced-bin histogram, pure
+  jittable jnp (the ``obs/diagnostics.py`` idiom: safe inside shard_map,
+  traced only under ``config.telemetry``). The step emits the score
+  table's and the per-batch IS weights' histograms as per-bin scalar
+  metrics (``sampler_dist/score_hist/bNN`` / ``sampler_dist/w_hist/bNN``)
+  — per-bin scalars, not a vector, because the async writer reduces every
+  record value with ``np.mean`` (obs/writer.py ``_to_host_record``).
+- the **selection-count ledger** (``MercuryState.sel_counts``, ``[W, L]``
+  int32): the step scatter-adds the trained slots each step; the
+  host-side :class:`SamplerHealthMonitor` fetches it on the log cadence
+  and derives coverage, a selection Gini, per-class selection spread, and
+  an empirical-vs-expected inclusion-bias audit against the live table's
+  normalized scores.
+- the **grad-variance probe** (``config.variance_probe_every``): the step
+  runs one extra scoring-model microbatch pass and emits
+  ``sampler_dist/var_ratio`` — the estimated IS-vs-uniform gradient-norm
+  second-moment ratio, the gate signal of Katharopoulos & Fleuret
+  (arXiv:1803.00942): sustained ``>= 1`` means importance sampling is
+  currently *losing* to uniform. :func:`variance_probe_ratio` is the pure
+  estimator the step calls, kept here so the CPU cross-validation against
+  ``benchmarks/grad_variance.py`` tests one definition.
+
+Everything host-side is numpy-only on fetched arrays — nothing here ever
+touches the traced program, and with ``telemetry=False`` neither the
+ledger nor the histograms exist at all (Layer-2/3 digest-enforced).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import numpy as np
+
+# --- in-graph half ---------------------------------------------------------
+
+#: Fixed bin count shared by every emitted histogram. Fixed (not a config
+#: knob) because each bin is its own registered metric key
+#: (``obs/registry.py`` is exact-match) and the flight recorder / report
+#: renderers index bins positionally.
+HIST_BINS = 16
+#: Log-spaced edges for the score-table histogram: scores are per-sample
+#: CE losses / grad-norm bounds, floored at SCORE_FLOOR=1e-12 and rarely
+#: above ~1e2; out-of-range values clamp into the end bins, so counts
+#: always total the table length.
+SCORE_HIST_LO, SCORE_HIST_HI = 1e-6, 1e2
+#: Log-spaced edges for the IS-weight histogram. ``scaled_probs = L·p``
+#: is the *inverse* of the reweight (loss_i / scaled_probs_i): 1.0 is the
+#: uniform weight, the interesting tails sit orders of magnitude away on
+#: either side.
+WEIGHT_HIST_LO, WEIGHT_HIST_HI = 1e-4, 1e4
+
+
+def log_bin_histogram(x, lo: float, hi: float, bins: int = HIST_BINS):
+    """Histogram of ``x`` over ``bins`` log-spaced bins spanning
+    ``[lo, hi)``; values below ``lo`` clamp into bin 0 and values at or
+    above ``hi`` into the last bin, so ``sum(counts) == x.size`` always.
+    Pure jittable jnp — safe inside shard_map; psum the result over the
+    data axis for a global histogram."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32).reshape(-1)
+    lo_l, hi_l = math.log(lo), math.log(hi)
+    idx = jnp.floor(
+        (jnp.log(jnp.maximum(x, lo)) - lo_l) / (hi_l - lo_l) * bins
+    ).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, bins - 1)
+    return jnp.zeros((bins,), jnp.int32).at[idx].add(1)
+
+
+def log_bin_histogram_np(x, lo: float, hi: float,
+                         bins: int = HIST_BINS) -> np.ndarray:
+    """Numpy reference for :func:`log_bin_histogram` — same clamp-into-end
+    -bins semantics, same f32 arithmetic (the bit-match test pins the two
+    together)."""
+    x = np.asarray(x, np.float32).reshape(-1)
+    lo_l, hi_l = math.log(lo), math.log(hi)
+    idx_f = np.floor(
+        (np.log(np.maximum(x, np.float32(lo))) - np.float32(lo_l))
+        / np.float32(hi_l - lo_l) * np.float32(bins)
+    )
+    # Clip BEFORE the int cast: numpy's float→int32 cast of +inf wraps to
+    # INT32_MIN while XLA's saturates to INT32_MAX — clipping in float
+    # space makes +inf land in the last bin in both implementations.
+    idx = np.nan_to_num(np.clip(idx_f, 0, bins - 1), nan=0.0).astype(
+        np.int32)
+    return np.bincount(idx, minlength=bins).astype(np.int32)
+
+
+def hist_bin_edges(lo: float, hi: float,
+                   bins: int = HIST_BINS) -> np.ndarray:
+    """The ``bins + 1`` log-spaced edges the histograms above bin by —
+    for report axes and docs, host-side only."""
+    return np.exp(np.linspace(math.log(lo), math.log(hi), bins + 1))
+
+
+def hist_keys(family: str, bins: int = HIST_BINS):
+    """The per-bin metric keys a histogram family emits, in bin order —
+    one definition shared by the step emitters, the anomaly engine's
+    flight-record attachment, and the report renderer."""
+    return tuple(f"sampler_dist/{family}/b{i:02d}" for i in range(bins))
+
+
+def variance_probe_ratio(grad_norms, scaled_probs, eps: float = 1e-30):
+    """The ``sampler_dist/var_ratio`` estimator, on one IS-drawn
+    microbatch: per-example grad-norm (bound) ``g_i`` and the draw-time
+    ``scaled_probs_i = L·p_i``.
+
+    With samples drawn from ``p``, ``mean((g/(L·p))²)`` estimates the IS
+    gradient estimator's second moment ``E_p[(g/(L·p))²]`` directly, and
+    ``mean(g²/(L·p))`` estimates the uniform estimator's second moment
+    ``E_unif[g²]`` by the same unbiased reweighting the loss uses. Their
+    ratio follows ``benchmarks/grad_variance.py``'s convention
+    (``ratio < 1`` ⇔ importance sampling wins); uniform weights give
+    exactly 1. Second moments, not centered variances — the shared mean
+    term cancels in the regime the gate cares about (1803.00942 §3 makes
+    the same approximation). jnp in, jnp out (also valid on numpy)."""
+    import jax.numpy as jnp
+
+    g = jnp.asarray(grad_norms, jnp.float32)
+    sp = jnp.maximum(jnp.asarray(scaled_probs, jnp.float32), eps)
+    m_is = jnp.mean(jnp.square(g / sp))
+    m_unif = jnp.mean(jnp.square(g) / sp)
+    return m_is / jnp.maximum(m_unif, eps)
+
+
+# --- host-side half --------------------------------------------------------
+
+
+def ledger_global_counts(counts_wl: np.ndarray,
+                         shard_indices: np.ndarray,
+                         n_samples: int) -> np.ndarray:
+    """Aggregate the ``[W, L]`` per-slot ledger to per-SAMPLE counts
+    ``[n]``: cyclic-tiling duplicates (one sample owning several slots of
+    a row) and cross-worker ownership both SUM — unlike the score carry's
+    last-wins, a count is additive."""
+    out = np.zeros((n_samples,), np.int64)
+    np.add.at(out, np.asarray(shard_indices).reshape(-1),
+              np.asarray(counts_wl, np.int64).reshape(-1))
+    return out
+
+
+def gini(counts: np.ndarray) -> float:
+    """Gini coefficient of the selection-count distribution: 0 = every
+    sample drawn equally often, →1 = all draws on a vanishing fraction.
+    Standard mean-absolute-difference form on sorted counts."""
+    c = np.sort(np.asarray(counts, np.float64))
+    n = c.size
+    total = c.sum()
+    if n == 0 or total <= 0:
+        return 0.0
+    cum = np.cumsum(c)
+    # G = (n + 1 - 2·sum(cum)/total) / n
+    return float((n + 1 - 2.0 * cum.sum() / total) / n)
+
+
+def class_spread(counts_global: np.ndarray, labels: np.ndarray,
+                 num_classes: int,
+                 starvation_share: float = 0.2) -> Dict[str, float]:
+    """Per-class selection spread: each class's share of total draws over
+    its share of the dataset (1.0 = drawn proportionally). A class whose
+    ratio sits below ``starvation_share`` counts as starved — the
+    ``class_starvation`` trigger fires on the count."""
+    labels = np.asarray(labels)
+    counts_global = np.asarray(counts_global, np.float64)
+    total = counts_global.sum()
+    sel_per_class = np.zeros((num_classes,), np.float64)
+    np.add.at(sel_per_class, labels, counts_global)
+    data_per_class = np.bincount(labels, minlength=num_classes).astype(
+        np.float64)
+    present = data_per_class > 0
+    if total <= 0 or not present.any():
+        return {"class_share_min": 1.0, "class_share_max": 1.0,
+                "class_starved": 0.0}
+    ratio = (sel_per_class[present] / total) / (
+        data_per_class[present] / labels.size)
+    return {
+        "class_share_min": float(ratio.min()),
+        "class_share_max": float(ratio.max()),
+        "class_starved": float(np.sum(ratio < starvation_share)),
+    }
+
+
+def bias_audit(counts_wl: np.ndarray, probs_wl: np.ndarray,
+               threshold: float = 5.0) -> Dict[str, float]:
+    """Empirical-vs-expected inclusion-bias audit: observed per-slot
+    selection frequency against the table's CURRENT normalized scores.
+
+    χ²-style drift stat per degree of freedom:
+    ``mean_slots((obs − exp)² / max(exp, 1))`` with
+    ``exp = draws_w · p_w[slot]`` per worker row — ≈1 when the draws
+    track the table (multinomial noise), growing without bound as the
+    observed frequencies drift from the distribution the table claims.
+    Not an exact test (the table evolves while the ledger accumulates —
+    that drift is precisely what the stat surfaces); ``threshold`` sets
+    the ``bias_ok`` verdict the report prints."""
+    counts = np.asarray(counts_wl, np.float64)
+    probs = np.asarray(probs_wl, np.float64)
+    if counts.ndim == 1:
+        counts, probs = counts[None], probs[None]
+    draws = counts.sum(axis=1, keepdims=True)
+    if counts.size == 0 or draws.sum() <= 0:
+        return {"bias_chi2": 0.0, "bias_ok": 1.0}
+    exp = draws * probs
+    stat = float(np.mean(np.square(counts - exp) / np.maximum(exp, 1.0)))
+    return {"bias_chi2": stat, "bias_ok": 1.0 if stat < threshold else 0.0}
+
+
+def table_probs_np(scores: np.ndarray, ema_value: np.ndarray,
+                   alpha: float) -> np.ndarray:
+    """Numpy mirror of ``sampling.scoretable.table_probs`` (smoothed →
+    floored → normalized, per worker row) so the audit never has to trace
+    anything. ``scores`` ``[W, L]``, ``ema_value`` ``[W]``."""
+    from mercury_tpu.sampling.importance import SCORE_FLOOR
+
+    smoothed = np.asarray(scores, np.float64) + alpha * np.asarray(
+        ema_value, np.float64)[:, None]
+    clipped = np.maximum(smoothed, SCORE_FLOOR)
+    return clipped / clipped.sum(axis=1, keepdims=True)
+
+
+def sparkline(values, width: Optional[int] = None) -> str:
+    """Unicode sparkline of a histogram (▁▂▃▄▅▆▇█), for the report's
+    sampler-health section. Empty bins render as the lowest glyph; all
+    -zero input renders flat."""
+    blocks = "▁▂▃▄▅▆▇█"
+    v = np.asarray(list(values), np.float64)
+    if width is not None and v.size > width:
+        v = v[:width]
+    if v.size == 0:
+        return ""
+    top = v.max()
+    if top <= 0:
+        return blocks[0] * v.size
+    idx = np.minimum((v / top * (len(blocks) - 1)).astype(int),
+                     len(blocks) - 1)
+    return "".join(blocks[i] for i in idx)
+
+
+class SamplerHealthMonitor:
+    """Host-side ledger→metrics derivation, merged into the log-gate
+    record like ``StreamPipeline.stats()`` — one device fetch of the
+    ``[W, L]`` int32 ledger (plus the score table for the bias audit) per
+    log tick, numpy from there.
+
+    Single-controller only (the ledger is a global array; a
+    multi-process run cannot ``device_get`` non-addressable shards) —
+    the Trainer simply doesn't construct one when
+    ``jax.process_count() > 1``, mirroring the async scorer fleet's
+    constraint."""
+
+    def __init__(self, shard_indices: np.ndarray, labels: np.ndarray,
+                 num_classes: int, is_alpha: float,
+                 starvation_share: float = 0.2,
+                 bias_threshold: float = 5.0):
+        self._sidx = np.asarray(shard_indices)
+        self._labels = np.asarray(labels)
+        self._n = int(self._labels.size)
+        self._num_classes = int(num_classes)
+        self._alpha = float(is_alpha)
+        self._starvation_share = float(starvation_share)
+        self._bias_threshold = float(bias_threshold)
+
+    def stats(self, state) -> Dict[str, float]:
+        import jax
+
+        if state.sel_counts is None:
+            return {}
+        counts = np.asarray(jax.device_get(state.sel_counts))
+        out: Dict[str, float] = {}
+        global_counts = ledger_global_counts(counts, self._sidx, self._n)
+        out["sampler_dist/frac_never_selected"] = float(
+            np.mean(global_counts == 0))
+        out["sampler_dist/gini"] = gini(global_counts)
+        spread = class_spread(global_counts, self._labels,
+                              self._num_classes, self._starvation_share)
+        out["sampler_dist/class_share_min"] = spread["class_share_min"]
+        out["sampler_dist/class_share_max"] = spread["class_share_max"]
+        out["sampler_dist/class_starved"] = spread["class_starved"]
+        if state.scoretable is not None:
+            scores = np.asarray(jax.device_get(state.scoretable.scores))
+            ema = np.asarray(jax.device_get(state.ema.value))
+            audit = bias_audit(
+                counts, table_probs_np(scores, ema, self._alpha),
+                self._bias_threshold,
+            )
+            out["sampler_dist/bias_chi2"] = audit["bias_chi2"]
+            out["sampler_dist/bias_ok"] = audit["bias_ok"]
+        return out

@@ -406,7 +406,8 @@ class HeartbeatSink:
 
     _KEYS = ("train/loss", "train/acc", "perf/steps_per_s",
              "perf/examples_per_s", "perf/mfu", "sampler/ess",
-             "data/stall_s", "obs/dropped", "anomaly/triggers")
+             "sampler/is_active", "data/stall_s", "obs/dropped",
+             "anomaly/triggers")
 
     def __init__(self, every_steps: int = 100, min_interval_s: float = 1.0,
                  stream=None) -> None:

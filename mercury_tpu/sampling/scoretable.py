@@ -37,6 +37,13 @@ Everything here is the pure jax-native formulation; the fused Pallas
 kernel (``ops.mercury_kernels.table_refresh_draw_pallas``) implements
 steps 2-3 in one VMEM pass and is tested equivalent under
 ``interpret=True``.
+
+Observability: under ``telemetry=True`` the step emits the post-refresh
+table's log-binned histogram (``sampler_dist/score_hist/*``) and
+scatter-adds every trained slot into the ``MercuryState.sel_counts``
+selection-count ledger; ``obs/sampler_health.py`` owns the histogram /
+ledger derivations (coverage, Gini, inclusion-bias audit against
+:func:`table_probs` — its numpy mirror ``table_probs_np`` lives there).
 """
 
 from __future__ import annotations

@@ -107,6 +107,14 @@ def _read_raw_state(directory: str, template: MercuryState,
 
     if raw is None:
         raw, step = probe_checkpoint(directory, step, strict=True)
+    # Upgrade shim: checkpoints written before the selection-count ledger
+    # existed (or by a telemetry=False run) carry no `sel_counts` entry;
+    # restoring one into a ledger-bearing template must not fail the
+    # whole resume — drop the field from the template and let the caller
+    # keep its fresh zero ledger.
+    if template.sel_counts is not None and isinstance(raw, dict) \
+            and raw.get("sel_counts") is None:
+        template = template.replace(sel_counts=None)
     # from_state_dict maps the raw dict back onto the template STRUCTURE
     # without reshaping values — exactly what elastic needs: old-shape
     # leaves inside a navigable MercuryState.
@@ -245,6 +253,27 @@ def _carry_streamed_state(trainer, old: Any, template: MercuryState,
             scores=jnp.asarray(global_scores[new_sidx], jnp.float32),
             cursor=jnp.asarray(cursor),
         )
+        # Selection-count ledger (obs/sampler_health.py): also per-SAMPLE
+        # state wearing per-worker clothes, but ADDITIVE — cyclic-tiling
+        # duplicates SUM into the global count (unlike the scores'
+        # last-wins), and each sample's total is scattered to its FIRST
+        # slot in the new matrix only (later duplicates start at 0), so
+        # the global per-sample counts carry EXACTLY across any (W, L)
+        # change (test-pinned, tests/test_sampler_health.py).
+        old_led = getattr(old, "sel_counts", None)
+        if old_led is not None and template.sel_counts is not None:
+            old_counts = np.asarray(old_led, np.int64)
+            if old_counts.shape == (w_old, l_old):
+                global_counts = np.zeros((n,), np.int64)
+                np.add.at(global_counts, old_sidx.reshape(-1),
+                          old_counts.reshape(-1))
+                flat = new_sidx.reshape(-1)
+                uniq, first_idx = np.unique(flat, return_index=True)
+                new_counts = np.zeros((flat.size,), np.int64)
+                new_counts[first_idx] = global_counts[uniq]
+                extra["sel_counts"] = jnp.asarray(
+                    new_counts.reshape(new_sidx.shape), jnp.int32
+                )
     return extra
 
 

@@ -83,6 +83,10 @@ class MercuryState:
     cached_pool: Any = None         # [W]-stacked CachedPool (score_refresh_every>1)
     scoretable: Any = None          # [W]-stacked ScoreTableState (sampler="scoretable")
     pending_sel: Any = None         # [W]-stacked PendingSelection (host_stream)
+    sel_counts: Any = None          # [W, L] int32 selection-count ledger
+                                    # (scoretable + telemetry): draws of
+                                    # each shard slot consumed by training
+                                    # so far (obs/sampler_health.py)
 
 
 def init_worker_sampler_state(
@@ -123,6 +127,7 @@ def create_state(
     stream_depth: int = 0,
     stream_emit_size: int = 0,
     stream_batch_size: int = 0,
+    with_sel_counts: bool = False,
 ) -> MercuryState:
     """Initialize model/optimizer/sampler state.
 
@@ -215,6 +220,14 @@ def create_state(
         scoretable = jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(x, (n_workers,) + x.shape), t0
         )
+    sel_counts = None
+    if with_sel_counts:
+        # Selection-count ledger (obs/sampler_health.py): zeros until the
+        # first trained batch scatter-adds its slots. Rides alongside the
+        # scoretable (same [W, L] geometry) but is a MercuryState field of
+        # its own so the ScoreTableState constructors in the step and the
+        # elastic carry stay untouched.
+        sel_counts = jnp.zeros((n_workers, shard_len), jnp.int32)
     return MercuryState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -228,6 +241,7 @@ def create_state(
         cached_pool=cached_pool,
         scoretable=scoretable,
         pending_sel=pending_sel,
+        sel_counts=sel_counts,
     )
 
 

@@ -38,6 +38,14 @@ from mercury_tpu.obs.diagnostics import (
     global_grad_norm,
     table_age_summary,
 )
+from mercury_tpu.obs.sampler_health import (
+    SCORE_HIST_HI,
+    SCORE_HIST_LO,
+    WEIGHT_HIST_HI,
+    WEIGHT_HIST_LO,
+    hist_keys,
+    log_bin_histogram,
+)
 from mercury_tpu.parallel.collectives import allreduce_mean_tree
 from mercury_tpu.sampling.importance import (
     EMAState,
@@ -75,6 +83,7 @@ def _state_specs(
     axis: str, has_groupwise: bool = False, has_pending: bool = False,
     zero_sharding: bool = False, has_cached_pool: bool = False,
     has_scoretable: bool = False, has_pending_sel: bool = False,
+    has_sel_counts: bool = False,
 ) -> MercuryState:
     """PartitionSpec pytree-prefix for :class:`MercuryState`: model state
     replicated, per-worker sampler state sharded along the data axis;
@@ -93,6 +102,7 @@ def _state_specs(
         cached_pool=P(axis) if has_cached_pool else None,
         scoretable=P(axis) if has_scoretable else None,
         pending_sel=P(axis) if has_pending_sel else None,
+        sel_counts=P(axis) if has_sel_counts else None,
     )
 
 
@@ -100,7 +110,7 @@ def mercury_state_out_shardings(
     mesh: Mesh, axis: str, params_sh, opt_sh,
     has_groupwise: bool = False, has_pending: bool = False,
     has_cached_pool: bool = False, has_scoretable: bool = False,
-    has_pending_sel: bool = False,
+    has_pending_sel: bool = False, has_sel_counts: bool = False,
 ) -> Tuple[MercuryState, Any]:
     """Output shardings pinning the post-step state layout under partial-
     auto meshes (dp×tp): without this, GSPMD is free to re-replicate the
@@ -132,6 +142,7 @@ def mercury_state_out_shardings(
         # Raw uint32 key data (train/state.py PendingSelection) — no PRNG
         # key leaf, so the tiled sharding is safe on legacy jax too.
         pending_sel=n(P(axis)) if has_pending_sel else None,
+        sel_counts=n(P(axis)) if has_sel_counts else None,
     )
     return state_sh, n(P())
 
@@ -318,6 +329,26 @@ def make_train_step(
     if config.importance_score not in ("loss", "grad_norm"):
         raise ValueError(
             f"unknown importance_score {config.importance_score!r}"
+        )
+    # Selection-count ledger (obs/sampler_health.py): rides alongside the
+    # scoretable, trace-gated with the rest of the telemetry — with
+    # telemetry=False the state carries no ledger and the program is the
+    # seed's, byte-identical (Layer-2/3 digest-enforced).
+    use_ledger = use_scoretable and telemetry
+    probe_every = int(config.variance_probe_every)
+    if probe_every < 0:
+        raise ValueError(
+            f"variance_probe_every must be >= 0, got {probe_every}"
+        )
+    # Grad-variance probe (sampler_dist/var_ratio): one extra
+    # scoring-model pass over the trained microbatch every probe_every
+    # steps. Trace-gated like the ledger; meaningless without IS weights.
+    use_probe = telemetry and probe_every > 0 and use_is
+    if use_probe and scan_steps > 1:
+        raise ValueError(
+            "variance_probe_every > 0 requires scan_steps == 1: scanned "
+            "chunks mean their metrics, which would blend the probe's "
+            "-1.0 off-step sentinel into the ratio"
         )
     if config.data_placement not in ("replicated", "sharded", "host_stream"):
         raise ValueError(
@@ -549,6 +580,60 @@ def make_train_step(
             )
             pool_logits = pool_logits.astype(jnp.float32)
         return imgs, pool_logits, _score_per_sample(pool_logits, labs)
+
+    def probe_var_ratio(state, sel_images, sel_labels, scaled_probs):
+        """Grad-variance probe (``sampler_dist/var_ratio``, the
+        1803.00942 gate signal, observe-only): every ``probe_every``-th
+        step, ONE extra scoring-model pass over the just-trained
+        microbatch yields per-example grad-norm bounds ``g_i``; with the
+        batch drawn from ``p`` and ``scaled_probs_i = N·p_i``,
+        ``pool_mean((g/(N·p))²)`` estimates the IS gradient estimator's
+        second moment and ``pool_mean(g²/(N·p))`` the uniform one (same
+        unbiased reweighting as the loss). Their ratio follows
+        ``benchmarks/grad_variance.py``'s convention: < 1 ⇔ IS is
+        winning. Uses PRE-update params (``state`` is the input state) —
+        the distribution the draw actually came from. Off-cadence steps
+        return the -1.0 sentinel every consumer ignores."""
+
+        def run(_):
+            with jax.named_scope("mercury_variance_probe"):
+                if scoring_model is None:
+                    logits, _, _ = _apply_train(
+                        state.params, state.batch_stats, sel_images, False
+                    )
+                else:
+                    s_in = (sel_images.astype(jnp.bfloat16)
+                            if scoring_bf16 else sel_images)
+                    variables = {"params": state.params}
+                    mutable = ["losses"]
+                    if state.batch_stats:
+                        variables["batch_stats"] = state.batch_stats
+                        mutable = ["batch_stats", "losses"]
+                    logits, _ = scoring_model.apply(
+                        variables, s_in, train=True, mutable=mutable
+                    )
+                g = per_sample_grad_norm_bound(
+                    logits.astype(jnp.float32), sel_labels,
+                    config.label_smoothing,
+                )
+            sp = jnp.maximum(scaled_probs.astype(jnp.float32), 1e-30)
+            # Pool the moments across workers BEFORE the ratio (a pmean
+            # of per-worker ratios is not the global ratio);
+            # obs/sampler_health.variance_probe_ratio is the single-host
+            # reference the tests cross-validate against.
+            m_is = pool_mean(jnp.square(g / sp), stat_axis)
+            m_unif = pool_mean(jnp.square(g) / sp, stat_axis)
+            return m_is / jnp.maximum(m_unif, 1e-30)
+
+        # Cadence on the POST-increment step: metric records carry
+        # state.step + 1, so this makes the probe land on the records
+        # whose step is a multiple of probe_every — aligning with
+        # log_every (set probe_every to a multiple of it), instead of
+        # emitting the sentinel one record off forever.
+        return lax.cond(
+            (state.step + 1) % probe_every == 0, run,
+            lambda _: jnp.full((), -1.0, jnp.float32), operand=None,
+        )
 
     def train_update(state, rng, sel_images, sel_labels, scaled_probs):
         """The train back-end — the second half of the fused step, split
@@ -1067,8 +1152,13 @@ def make_train_step(
         logits = upd["logits"]
         if telemetry:
             grad_norm = upd["grad_norm"]
+        if use_probe:
+            var_ratio = probe_var_ratio(
+                state, sel_images, sel_labels, scaled_probs
+            )
 
         new_scoretable = state.scoretable
+        new_sel_counts = state.sel_counts
         if use_scoretable:
             # Free write-back: the train forward's logits re-score the
             # just-trained slots for zero extra FLOPs (they fall out of the
@@ -1101,6 +1191,24 @@ def make_train_step(
             new_scoretable = jax.tree_util.tree_map(
                 lambda x: x[None], new_table
             )
+            if use_ledger:
+                # Selection-count ledger: the drawn batch IS the trained
+                # batch on this path, so counting at train time counts
+                # every draw exactly once (with-replacement duplicates
+                # add once per occurrence).
+                new_sel_counts = (
+                    state.sel_counts[0].at[table_selected].add(1)
+                )[None]
+            if telemetry:
+                # Global (psum'd) histogram of the post-refresh table —
+                # the distribution the NEXT draw normalizes. Per-bin
+                # scalars: the async writer means any vector.
+                score_hist = lax.psum(
+                    log_bin_histogram(
+                        new_table.scores, SCORE_HIST_LO, SCORE_HIST_HI
+                    ),
+                    axis,
+                )
 
         new_state = MercuryState(
             step=state.step + 1,
@@ -1124,6 +1232,7 @@ def make_train_step(
             ),
             scoretable=new_scoretable,
             pending_sel=state.pending_sel,
+            sel_counts=new_sel_counts,
         )
         metrics = {
             "train/loss": upd["loss_mean"],
@@ -1147,6 +1256,24 @@ def make_train_step(
                 metrics["sampler/table_age_min"] = age_min
                 metrics["sampler/table_age_mean"] = age_mean
                 metrics["sampler/table_age_max"] = age_max
+            if use_is:
+                # Per-batch IS-weight histogram (scaled_probs = N·p, the
+                # reweight's divisor), psum'd global.
+                w_hist = lax.psum(
+                    log_bin_histogram(
+                        scaled_probs, WEIGHT_HIST_LO, WEIGHT_HIST_HI
+                    ),
+                    axis,
+                )
+                for i, k in enumerate(hist_keys("w_hist")):
+                    metrics[k] = w_hist[i]
+            if use_scoretable:
+                for i, k in enumerate(hist_keys("score_hist")):
+                    metrics[k] = score_hist[i]
+            if use_probe:
+                metrics["sampler_dist/var_ratio"] = lax.pmean(
+                    var_ratio, axis
+                )
         return new_state, metrics
 
     def hs_body(state: MercuryState, x_stream, y_train, shard_indices):
@@ -1268,10 +1395,15 @@ def make_train_step(
         logits = upd["logits"]
         if telemetry:
             grad_norm = upd["grad_norm"]
+        if use_probe:
+            var_ratio = probe_var_ratio(
+                state, sel_images, sel_labels, scaled_probs
+            )
 
         # --- lookahead draw for step t+depth -----------------------------
         next_scaled = jnp.ones((batch_size,), jnp.float32)
         new_scoretable = state.scoretable
+        new_sel_counts = state.sel_counts
         if use_scoretable:
             # Write-back first (train logits re-score the trained slots),
             # then draw from the freshest table this host can have.
@@ -1325,11 +1457,26 @@ def make_train_step(
             new_scoretable = jax.tree_util.tree_map(
                 lambda x: x[None], new_table
             )
+            if use_ledger:
+                # Ledger counts at TRAIN time (the ring front consumed
+                # this step), not at draw time — so the counts equal the
+                # examples actually trained on and the in-flight ring is
+                # not yet counted. tests/test_sampler_health.py pins this
+                # against a host-side ring replay.
+                new_sel_counts = (
+                    state.sel_counts[0].at[train_slots].add(1)
+                )[None]
             if telemetry:
                 # Clip over the table the NEXT draw normalizes (the
                 # freshest distribution this step produced).
                 clip_frac = clip_fraction(
                     table_after, ema.value, config.is_alpha
+                )
+                score_hist = lax.psum(
+                    log_bin_histogram(
+                        table_after, SCORE_HIST_LO, SCORE_HIST_HI
+                    ),
+                    axis,
                 )
         else:
             # Uniform/pool: the draw is param-independent, so running it
@@ -1365,6 +1512,7 @@ def make_train_step(
             pending_sel=jax.tree_util.tree_map(
                 lambda x: x[None], new_psel
             ),
+            sel_counts=new_sel_counts,
         )
         metrics = {
             "train/loss": upd["loss_mean"],
@@ -1384,6 +1532,22 @@ def make_train_step(
                 metrics["sampler/table_age_min"] = age_min
                 metrics["sampler/table_age_mean"] = age_mean
                 metrics["sampler/table_age_max"] = age_max
+            if use_is:
+                w_hist = lax.psum(
+                    log_bin_histogram(
+                        scaled_probs, WEIGHT_HIST_LO, WEIGHT_HIST_HI
+                    ),
+                    axis,
+                )
+                for i, k in enumerate(hist_keys("w_hist")):
+                    metrics[k] = w_hist[i]
+            if use_scoretable:
+                for i, k in enumerate(hist_keys("score_hist")):
+                    metrics[k] = score_hist[i]
+            if use_probe:
+                metrics["sampler_dist/var_ratio"] = lax.pmean(
+                    var_ratio, axis
+                )
         return new_state, metrics, next_gidx
 
     if host_stream:
@@ -1403,7 +1567,8 @@ def make_train_step(
                          has_pending=pipelined, zero_sharding=zero,
                          has_cached_pool=use_cadence,
                          has_scoretable=use_scoretable,
-                         has_pending_sel=host_stream)
+                         has_pending_sel=host_stream,
+                         has_sel_counts=use_ledger)
     smap_kw = {}
     if auto_axes:
         # Manual over the data axis only; GSPMD handles the rest.
@@ -1514,6 +1679,10 @@ def make_host_stream_prime(config: TrainConfig, mesh: Mesh):
     emit_size = (batch_size if async_refresh
                  else (refresh_size + batch_size) if use_scoretable
                  else pool_size)
+    # Same gate as make_train_step: the ledger exists iff the step carries
+    # it — the prime passes it through untouched, but the spec prefix must
+    # cover the field.
+    use_ledger = use_scoretable and bool(config.telemetry)
 
     def prime(state: MercuryState, shard_indices):
         stream = ShardStream(perm=state.stream.perm[0],
@@ -1570,6 +1739,7 @@ def make_host_stream_prime(config: TrainConfig, mesh: Mesh):
     specs = _state_specs(
         axis, zero_sharding=config.zero_sharding,
         has_scoretable=use_scoretable, has_pending_sel=True,
+        has_sel_counts=use_ledger,
     )
     sharded = shard_map(
         prime,

@@ -52,6 +52,7 @@ from mercury_tpu.obs.aggregate import (
 )
 from mercury_tpu.obs.anomaly import AnomalyEngine
 from mercury_tpu.obs.manifest import build_run_manifest, write_run_manifest
+from mercury_tpu.obs.sampler_health import SamplerHealthMonitor
 from mercury_tpu.obs.trace import NULL_TRACER, SpanTracer
 from mercury_tpu.obs.writer import (
     AsyncMetricWriter,
@@ -264,6 +265,16 @@ class Trainer:
                 config.use_importance_sampling
                 and config.sampler == "scoretable"
             ),
+            # Selection-count ledger rides only when the step will
+            # actually scatter into it — scoretable sampler AND telemetry
+            # on (obs/sampler_health.py). A telemetry=False run carries
+            # no ledger at all, keeping its traced program byte-identical
+            # to the seed's (Layer-2/3 digests).
+            with_sel_counts=(
+                config.use_importance_sampling
+                and config.sampler == "scoretable"
+                and bool(config.telemetry)
+            ),
             stream_depth=(config.prefetch_depth
                           if config.data_placement == "host_stream" else 0),
             stream_emit_size=self._stream_emit_size(),
@@ -406,6 +417,9 @@ class Trainer:
                                  and config.score_refresh_every > 1),
                 has_scoretable=(config.use_importance_sampling
                                 and config.sampler == "scoretable"),
+                has_sel_counts=(config.use_importance_sampling
+                                and config.sampler == "scoretable"
+                                and bool(config.telemetry)),
             )
             if jax.process_count() == 1:
                 # Pre-place the whole state with the pinned shardings (a
@@ -568,11 +582,37 @@ class Trainer:
                                 else 0.0),
                 mfu_floor=config.slo_mfu_floor,
                 straggler_factor=config.anomaly_straggler_factor,
+                gini_max=config.slo_selection_gini_max,
+                # Any starved class breaches — the share floor itself
+                # lives in the monitor's class_spread derivation.
+                starved_classes=(1.0 if config.slo_class_starvation_share
+                                 > 0 else 0.0),
+                var_ratio_patience=config.slo_var_ratio_patience,
                 cooldown_steps=config.anomaly_cooldown_steps,
                 dump_dir=config.anomaly_dir or config.log_dir,
                 tracer=self.tracer,
                 context_fn=self._flight_context,
                 profile_steps=config.anomaly_profile_steps,
+            )
+        # --- sampler-health monitor (obs/sampler_health.py): derives the
+        # coverage / Gini / class-spread / bias-audit scalars from the
+        # selection-count ledger at the log gate. Single-controller only
+        # — the ledger is a global array and device_get on another host's
+        # shards raises (same constraint as the async scorer fleet).
+        self._sampler_monitor: Optional[SamplerHealthMonitor] = None
+        if (
+            config.use_importance_sampling
+            and config.sampler == "scoretable"
+            and config.telemetry
+            and jax.process_count() == 1
+        ):
+            self._sampler_monitor = SamplerHealthMonitor(
+                np.asarray(self.dataset.shard_indices),
+                np.asarray(self.dataset.y_train),
+                self.dataset.num_classes,
+                config.is_alpha,
+                starvation_share=(config.slo_class_starvation_share
+                                  or 0.2),
             )
         # Observer order matters: the shard aggregator attaches host/*
         # keys first, then the anomaly engine reads them (straggler).
@@ -1299,6 +1339,14 @@ class Trainer:
                             record.update(self._scorer_fleet.stats())
                             record["sampler/chunks_rejected"] = float(
                                 self._chunks_rejected)
+                        if self._sampler_monitor is not None:
+                            # Ledger-derived distribution stats: ONE
+                            # [W, L] int32 device fetch per log tick
+                            # (plus the score table for the bias
+                            # audit) — the only log-gate merge that
+                            # touches the device, scaled by log_every.
+                            record.update(
+                                self._sampler_monitor.stats(self.state))
                         if self.supervisor is not None:
                             # Ladder level, restarts, degradations — and
                             # sampler/is_active (0.0 once uniform).
@@ -1716,6 +1764,9 @@ class Trainer:
                     has_scoretable=(cfg.use_importance_sampling
                                     and cfg.sampler == "scoretable"),
                     has_pending_sel=(cfg.data_placement == "host_stream"),
+                    has_sel_counts=(cfg.use_importance_sampling
+                                    and cfg.sampler == "scoretable"
+                                    and bool(cfg.telemetry)),
                 )
             # Identity jit, not a bare device_put: on CPU device_put may
             # zero-copy alias the checkpoint reader's host buffers, and

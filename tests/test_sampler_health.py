@@ -1,0 +1,601 @@
+"""Distribution-level sampler observability (``obs/sampler_health.py``
+plus the step's ``sampler_dist/*`` emitters): the in-graph log-binned
+histograms are pinned bit-exact to their numpy reference, the
+selection-count ledger is pinned EXACT against host-counted draws (body
+path by replaying the draw chain from the pre-step state, host_stream by
+reading the pending-selection ring front), the grad-variance probe is
+cross-validated against ``benchmarks/grad_variance.py``'s convention
+(``ratio < 1`` ⇔ importance sampling wins), and the ledger survives
+checkpoint/restore and elastic W→W′ resharding with exact per-sample
+counts."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.obs.sampler_health import (
+    HIST_BINS,
+    SCORE_HIST_HI,
+    SCORE_HIST_LO,
+    WEIGHT_HIST_HI,
+    WEIGHT_HIST_LO,
+    SamplerHealthMonitor,
+    bias_audit,
+    class_spread,
+    gini,
+    hist_bin_edges,
+    hist_keys,
+    ledger_global_counts,
+    log_bin_histogram,
+    log_bin_histogram_np,
+    sparkline,
+    table_probs_np,
+    variance_probe_ratio,
+)
+from mercury_tpu.parallel.mesh import host_cpu_mesh
+from mercury_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return host_cpu_mesh(4)
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return host_cpu_mesh(1)
+
+
+def table_cfg(**kw) -> TrainConfig:
+    base = dict(
+        model="smallcnn",
+        dataset="synthetic",
+        world_size=4,
+        batch_size=8,
+        presample_batches=2,
+        num_epochs=1,
+        steps_per_epoch=200,
+        eval_every=0,
+        log_every=0,
+        heartbeat_every=0,
+        checkpoint_every=0,
+        compute_dtype="float32",
+        seed=0,
+        sampler="scoretable",
+        refresh_size=8,
+        telemetry=True,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def run_steps(t, n):
+    m = None
+    for _ in range(n):
+        t.state, m = t.train_step(
+            t.state, t._step_x, t._step_y, t.dataset.shard_indices
+        )
+    return m
+
+
+class TestHistogram:
+    """log_bin_histogram (jnp, in-graph) vs log_bin_histogram_np: the
+    flight recorder and report render what the numpy reference says the
+    step emitted — the two must be BIT-identical, not close."""
+
+    EDGE_PAIRS = [(SCORE_HIST_LO, SCORE_HIST_HI),
+                  (WEIGHT_HIST_LO, WEIGHT_HIST_HI)]
+
+    def test_bit_match_vs_numpy_lognormal(self, rng):
+        for lo, hi in self.EDGE_PAIRS:
+            for size, sigma in [(1, 1.0), (57, 2.0), (4096, 6.0)]:
+                x = rng.lognormal(mean=0.0, sigma=sigma,
+                                  size=size).astype(np.float32)
+                want = log_bin_histogram_np(x, lo, hi)
+                got = np.asarray(log_bin_histogram(jnp.asarray(x), lo, hi))
+                np.testing.assert_array_equal(got, want)
+                assert int(got.sum()) == size
+
+    def test_bit_match_on_edges_and_clamps(self):
+        for lo, hi in self.EDGE_PAIRS:
+            edges = hist_bin_edges(lo, hi).astype(np.float32)
+            x = np.concatenate([
+                edges,                      # every bin boundary exactly
+                np.float32([0.0, lo / 10, lo, hi, hi * 10, 1.0, np.inf]),
+            ])
+            want = log_bin_histogram_np(x, lo, hi)
+            got = np.asarray(log_bin_histogram(jnp.asarray(x), lo, hi))
+            np.testing.assert_array_equal(got, want)
+            # Clamp-into-end-bins: counts always total the population.
+            assert int(got.sum()) == x.size
+
+    def test_below_lo_and_above_hi_land_in_end_bins(self):
+        h = log_bin_histogram_np(np.float32([1e-30, 0.0]), 1e-6, 1e2)
+        assert h[0] == 2 and h.sum() == 2
+        h = log_bin_histogram_np(np.float32([1e30, np.inf]), 1e-6, 1e2)
+        assert h[-1] == 2 and h.sum() == 2
+
+    def test_hist_keys_shape_and_registration(self):
+        from mercury_tpu.obs.registry import METRIC_KEYS
+
+        for family in ("score_hist", "w_hist"):
+            keys = hist_keys(family)
+            assert len(keys) == HIST_BINS
+            assert keys[0] == f"sampler_dist/{family}/b00"
+            assert keys[-1] == f"sampler_dist/{family}/b15"
+            for k in keys:
+                assert k in METRIC_KEYS, k
+        for k in ("sampler_dist/var_ratio", "sampler_dist/gini",
+                  "sampler_dist/frac_never_selected",
+                  "sampler_dist/class_share_min",
+                  "sampler_dist/class_share_max",
+                  "sampler_dist/class_starved", "sampler_dist/bias_chi2",
+                  "sampler_dist/bias_ok"):
+            assert k in METRIC_KEYS, k
+
+    def test_edges_are_log_spaced(self):
+        e = hist_bin_edges(1e-6, 1e2)
+        assert e.shape == (HIST_BINS + 1,)
+        np.testing.assert_allclose(e[0], 1e-6, rtol=1e-12)
+        np.testing.assert_allclose(e[-1], 1e2, rtol=1e-12)
+        ratios = e[1:] / e[:-1]
+        np.testing.assert_allclose(ratios, ratios[0], rtol=1e-9)
+
+    def test_sparkline_renders(self):
+        assert sparkline([0, 0, 0]) == "▁▁▁"
+        s = sparkline([0, 1, 2, 4, 8])
+        assert len(s) == 5 and s[-1] == "█"
+        assert sparkline([]) == ""
+
+
+class TestLedgerDerivations:
+    def test_global_counts_sum_duplicates(self):
+        # Sample 2 owns three slots (cyclic tiling + cross-worker): its
+        # counts SUM — additive, unlike the score carry's last-wins.
+        sidx = np.array([[2, 0, 2], [1, 2, 3]])
+        counts = np.array([[5, 1, 7], [2, 3, 4]])
+        out = ledger_global_counts(counts, sidx, 5)
+        np.testing.assert_array_equal(out, [1, 2, 15, 4, 0])
+
+    def test_gini_uniform_and_concentrated(self):
+        assert gini(np.full(100, 7)) == pytest.approx(0.0, abs=1e-12)
+        one_hot = np.zeros(100)
+        one_hot[3] = 1000
+        assert gini(one_hot) == pytest.approx(0.99, abs=1e-9)
+        assert gini(np.zeros(10)) == 0.0
+        assert gini(np.array([])) == 0.0
+
+    def test_class_spread_flags_starvation(self):
+        labels = np.array([0] * 50 + [1] * 50)
+        even = np.ones(100)
+        s = class_spread(even, labels, num_classes=2)
+        assert s["class_share_min"] == pytest.approx(1.0)
+        assert s["class_share_max"] == pytest.approx(1.0)
+        assert s["class_starved"] == 0.0
+        starved = np.concatenate([np.full(50, 99.0), np.full(50, 1.0)])
+        s = class_spread(starved, labels, num_classes=2,
+                         starvation_share=0.2)
+        assert s["class_starved"] == 1.0
+        assert s["class_share_min"] == pytest.approx(0.02)
+
+    def test_bias_audit_passes_faithful_draws(self, rng):
+        W, L, draws = 2, 64, 20_000
+        probs = rng.dirichlet(np.full(L, 5.0), size=W)
+        counts = np.stack([rng.multinomial(draws, probs[w])
+                           for w in range(W)])
+        audit = bias_audit(counts, probs)
+        assert audit["bias_ok"] == 1.0
+        # Multinomial noise keeps the per-dof stat near 1.
+        assert audit["bias_chi2"] < 5.0
+
+    def test_bias_audit_flags_tilted_sampler(self, rng):
+        # The table claims uniform; the draws actually came from a sharply
+        # tilted distribution — the audit must flag the drift.
+        L, draws = 64, 20_000
+        claimed = np.full((1, L), 1.0 / L)
+        tilted = np.linspace(1.0, 20.0, L)
+        tilted /= tilted.sum()
+        counts = rng.multinomial(draws, tilted)[None]
+        audit = bias_audit(counts, claimed)
+        assert audit["bias_ok"] == 0.0
+        assert audit["bias_chi2"] > 5.0
+
+    def test_bias_audit_empty_ledger_is_ok(self):
+        audit = bias_audit(np.zeros((2, 8)), np.full((2, 8), 1 / 8))
+        assert audit == {"bias_chi2": 0.0, "bias_ok": 1.0}
+
+    def test_table_probs_np_matches_traced(self):
+        from mercury_tpu.sampling.scoretable import table_probs
+
+        scores = np.abs(np.random.default_rng(3).normal(
+            size=(4, 33))).astype(np.float32)
+        ema = np.float32([0.5, 1.0, 2.0, 0.1])
+        want = np.stack([
+            np.asarray(table_probs(jnp.asarray(scores[w]),
+                                   jnp.float32(ema[w]), 0.5))
+            for w in range(4)
+        ])
+        got = table_probs_np(scores, ema, 0.5)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-12)
+
+
+class TestVarianceProbe:
+    """sampler_dist/var_ratio follows benchmarks/grad_variance.py's
+    convention: a ratio of (IS / uniform) gradient second moments,
+    ``< 1`` ⇔ importance sampling wins. Cross-validated on CPU against
+    the analytic second moments the benchmark's estimators converge
+    to."""
+
+    def _population(self, rng, L=512):
+        # Local fixed-seed generators (not the shared session rng): the
+        # adversarial estimator is heavy-tailed, so the assertions must
+        # not depend on how much of the shared stream earlier tests ate.
+        g = rng.lognormal(mean=0.0, sigma=1.0, size=L).astype(np.float32)
+        return g
+
+    def _exact_ratio(self, g, p):
+        # E_p[(g/(L·p))²] / E_unif[g²] — the single-draw second-moment
+        # ratio both grad_variance.py estimators report (mean terms
+        # cancel; 1803.00942 §3).
+        L = g.size
+        m_is = float(np.sum(p * (g / (L * p)) ** 2))
+        m_unif = float(np.mean(g**2))
+        return m_is / m_unif
+
+    def _probe_on_batch(self, rng, g, p, batch=8192):
+        sel = rng.choice(g.size, size=batch, p=p)
+        scaled = (p * g.size)[sel]
+        return float(variance_probe_ratio(g[sel], scaled))
+
+    def test_uniform_weights_give_exactly_one(self):
+        g = jnp.asarray([0.5, 1.0, 2.0, 4.0], jnp.float32)
+        sp = jnp.ones((4,), jnp.float32)  # L·p == 1 ⇔ uniform draw
+        assert float(variance_probe_ratio(g, sp)) == 1.0
+
+    def test_gradnorm_proportional_sampling_wins(self):
+        rng = np.random.default_rng(11)
+        g = self._population(rng)
+        p = g / g.sum()  # the 1803.00942 optimal proposal
+        exact = self._exact_ratio(g, p)
+        probe = self._probe_on_batch(rng, g, p)
+        assert exact < 1.0
+        assert probe < 1.0  # same side of the gate as the benchmark
+        # p ∝ g bounds the weights, so the estimate concentrates.
+        np.testing.assert_allclose(probe, exact, rtol=0.15)
+
+    def test_adversarial_sampling_loses(self):
+        rng = np.random.default_rng(12)
+        g = self._population(rng)
+        p = (1.0 / g) / (1.0 / g).sum()  # oversample the SMALL gradients
+        exact = self._exact_ratio(g, p)
+        probe = self._probe_on_batch(rng, g, p)
+        # Sign agreement only: w² ∝ g⁴ makes this estimator heavy-tailed,
+        # so the gate SIDE (the benchmark's convention) is the claim.
+        assert exact > 1.0
+        assert probe > 1.0
+
+    def test_ordering_matches_benchmark_convention(self):
+        # good proposal < uniform (== 1) < adversarial proposal — the
+        # ordering grad_variance.py's ratio_* columns encode.
+        rng = np.random.default_rng(13)
+        g = self._population(rng)
+        good = self._probe_on_batch(rng, g, g / g.sum())
+        bad = self._probe_on_batch(rng, g, (1 / g) / (1 / g).sum())
+        assert good < 1.0 < bad
+
+
+class TestAnomalyTriggers:
+    """The three sampler-health triggers, driven with synthesized records
+    (the test_trace_anomaly.py idiom — no model, fully deterministic)."""
+
+    def _record(self, step, **extra):
+        r = {"step": float(step), "time": 1000.0 + step, "train/loss": 1.0}
+        r.update(extra)
+        return r
+
+    def test_selection_collapse_attaches_histograms(self, tmp_path):
+        from mercury_tpu.obs.anomaly import AnomalyEngine
+
+        eng = AnomalyEngine(ring_steps=4, gini_max=0.8,
+                            dump_dir=str(tmp_path))
+        hist = {k: float(i) for i, k in enumerate(hist_keys("score_hist"))}
+        eng.observe_record(self._record(
+            3, **{"sampler_dist/gini": 0.95,
+                  "sampler_dist/frac_never_selected": 0.5}, **hist))
+        assert eng.trigger_counts == {"selection_collapse": 1}
+        (path,) = eng.dumps
+        doc = json.load(open(path))
+        detail = doc["trigger"]["detail"]
+        assert detail["gini"] == 0.95
+        assert detail["frac_never_selected"] == 0.5
+        for k, v in hist.items():
+            assert detail[k] == v
+
+    def test_selection_collapse_disarmed_by_default(self):
+        from mercury_tpu.obs.anomaly import AnomalyEngine
+
+        eng = AnomalyEngine(ring_steps=4)
+        eng.observe_record(self._record(1, **{"sampler_dist/gini": 0.999}))
+        assert eng.triggers == 0
+
+    def test_class_starvation(self):
+        from mercury_tpu.obs.anomaly import AnomalyEngine
+
+        eng = AnomalyEngine(ring_steps=4, starved_classes=1.0)
+        eng.observe_record(self._record(
+            1, **{"sampler_dist/class_starved": 0.0}))
+        assert eng.triggers == 0
+        eng.observe_record(self._record(
+            2, **{"sampler_dist/class_starved": 2.0,
+                  "sampler_dist/class_share_min": 0.01}))
+        assert eng.trigger_counts == {"class_starvation": 1}
+
+    def test_is_losing_needs_consecutive_breaches(self):
+        from mercury_tpu.obs.anomaly import AnomalyEngine
+
+        eng = AnomalyEngine(ring_steps=8, var_ratio_patience=3)
+        for s in (1, 2):
+            eng.observe_record(self._record(
+                s, **{"sampler_dist/var_ratio": 1.5}))
+        assert eng.triggers == 0
+        # A genuine healthy reading (< 1) resets the streak...
+        eng.observe_record(self._record(
+            3, **{"sampler_dist/var_ratio": 0.7}))
+        for s in (4, 5):
+            eng.observe_record(self._record(
+                s, **{"sampler_dist/var_ratio": 1.2}))
+        assert eng.triggers == 0
+        eng.observe_record(self._record(
+            6, **{"sampler_dist/var_ratio": 1.2}))
+        assert eng.trigger_counts == {"is_losing": 1}
+
+    def test_is_losing_sentinel_neither_counts_nor_resets(self):
+        from mercury_tpu.obs.anomaly import AnomalyEngine
+
+        eng = AnomalyEngine(ring_steps=8, var_ratio_patience=2)
+        eng.observe_record(self._record(
+            1, **{"sampler_dist/var_ratio": 1.5}))
+        # Off-cadence sentinel records (-1.0) must not break the streak.
+        eng.observe_record(self._record(
+            2, **{"sampler_dist/var_ratio": -1.0}))
+        eng.observe_record(self._record(
+            3, **{"sampler_dist/var_ratio": 1.5}))
+        assert eng.trigger_counts == {"is_losing": 1}
+
+
+class TestLedgerTrainer:
+    """The ledger counts the draws the step ACTUALLY trained on — pinned
+    exact over 200 steps by replaying the async body's draw chain
+    (decay → normalize → inverse-CDF on the pre-step table with the
+    step's own key split) on the host."""
+
+    def test_body_ledger_matches_replayed_draws_200_steps(self, mesh):
+        from mercury_tpu.sampling.scoretable import (
+            decay_scores,
+            table_draw_inverse_cdf,
+            table_probs,
+        )
+
+        cfg = table_cfg(refresh_mode="async", scorer_workers=1,
+                        snapshot_every=2)
+        t = Trainer(cfg, mesh=mesh)
+        try:
+            W = cfg.world_size
+            L = int(t.dataset.shard_indices.shape[1])
+            assert t.state.sel_counts.shape == (W, L)
+            assert int(np.asarray(t.state.sel_counts).sum()) == 0
+            expected = np.zeros((W, L), np.int64)
+            for _ in range(200):
+                scores = np.asarray(t.state.scoretable.scores)
+                ema = np.asarray(t.state.ema.value)
+                keys = jax.random.wrap_key_data(
+                    jnp.asarray(np.asarray(
+                        jax.random.key_data(t.state.rng))))
+                for w in range(W):
+                    # The body's rng_t 8-way split: position 2 is k_sel.
+                    k_sel = jax.random.split(keys[w], 8)[2]
+                    dec = decay_scores(
+                        jnp.asarray(scores[w], jnp.float32),
+                        jnp.float32(ema[w]), cfg.table_decay)
+                    probs = table_probs(dec, jnp.float32(ema[w]),
+                                        cfg.is_alpha)
+                    sel = np.asarray(table_draw_inverse_cdf(
+                        k_sel, probs, cfg.batch_size))
+                    expected[w] += np.bincount(sel, minlength=L)
+                run_steps(t, 1)
+            got = np.asarray(t.state.sel_counts)
+            np.testing.assert_array_equal(got, expected.astype(np.int32))
+            assert int(got.sum()) == 200 * W * cfg.batch_size
+
+            # The monitor derives from exactly this ledger.
+            mon = SamplerHealthMonitor(
+                np.asarray(t.dataset.shard_indices),
+                np.asarray(t.dataset.y_train),
+                t.dataset.num_classes, cfg.is_alpha)
+            stats = mon.stats(t.state)
+            gcounts = ledger_global_counts(
+                got, np.asarray(t.dataset.shard_indices),
+                int(np.asarray(t.dataset.y_train).size))
+            assert stats["sampler_dist/frac_never_selected"] == (
+                pytest.approx(float(np.mean(gcounts == 0))))
+            assert stats["sampler_dist/gini"] == pytest.approx(
+                gini(gcounts))
+            assert 0.0 <= stats["sampler_dist/bias_ok"] <= 1.0
+        finally:
+            t.close()
+
+    def test_telemetry_off_has_no_ledger(self, mesh):
+        t = Trainer(table_cfg(telemetry=False, steps_per_epoch=2),
+                    mesh=mesh)
+        try:
+            assert t.state.sel_counts is None
+            run_steps(t, 2)
+            assert t.state.sel_counts is None
+        finally:
+            t.close()
+
+
+class TestHostStreamLedger:
+    """Under ``data_placement="host_stream"`` the trained slots are the
+    pending-selection ring front — host-readable BEFORE the step runs, so
+    the expected counts need no replay at all."""
+
+    def _hs_cfg(self, **kw):
+        return table_cfg(world_size=1, data_placement="host_stream",
+                         prefetch_depth=2, **kw)
+
+    def test_sync_ledger_matches_ring_front_200_steps(self, mesh1):
+        cfg = self._hs_cfg()
+        t = Trainer(cfg, mesh=mesh1)
+        try:
+            L = int(t.dataset.shard_indices.shape[1])
+            expected = np.zeros((1, L), np.int64)
+            for _ in range(200):
+                front = np.asarray(t.state.pending_sel.slots)[:, 0, :]
+                # Sync layout: rows 0:R are the refresh window (never
+                # trained), rows R: are the train rows.
+                train_rows = front[:, cfg.refresh_size:]
+                for w in range(train_rows.shape[0]):
+                    expected[w] += np.bincount(train_rows[w], minlength=L)
+                t._host_stream_step()
+            np.testing.assert_array_equal(
+                np.asarray(t.state.sel_counts),
+                expected.astype(np.int32))
+            assert int(expected.sum()) == 200 * cfg.batch_size
+        finally:
+            t.close()
+
+    @pytest.mark.slow  # async+host_stream compile cost (matrix-tier call)
+    def test_async_ledger_counts_full_ring_front(self, mesh1):
+        cfg = self._hs_cfg(refresh_mode="async", scorer_workers=1,
+                           snapshot_every=2, steps_per_epoch=30)
+        t = Trainer(cfg, mesh=mesh1)
+        try:
+            L = int(t.dataset.shard_indices.shape[1])
+            expected = np.zeros((1, L), np.int64)
+            for _ in range(30):
+                front = np.asarray(t.state.pending_sel.slots)[:, 0, :]
+                # Async: the stream carries ONLY train rows — all of them
+                # count.
+                for w in range(front.shape[0]):
+                    expected[w] += np.bincount(front[w], minlength=L)
+                t._host_stream_step()
+            np.testing.assert_array_equal(
+                np.asarray(t.state.sel_counts),
+                expected.astype(np.int32))
+        finally:
+            t.close()
+
+
+class TestLedgerDurability:
+    def test_checkpoint_roundtrip_preserves_counts(self, mesh, tmp_path):
+        cfg = table_cfg(steps_per_epoch=8, checkpoint_dir=str(tmp_path))
+        t = Trainer(cfg, mesh=mesh)
+        try:
+            run_steps(t, 3)
+            t.save()
+            at_save = np.asarray(t.state.sel_counts).copy()
+            run_steps(t, 3)
+            want_final = np.asarray(t.state.sel_counts).copy()
+        finally:
+            t.close()
+        assert int(at_save.sum()) == 3 * 4 * cfg.batch_size
+
+        t2 = Trainer(cfg, mesh=mesh)
+        try:
+            t2.restore()
+            assert int(t2.state.step) == 3
+            np.testing.assert_array_equal(
+                np.asarray(t2.state.sel_counts), at_save)
+            # The continued trajectory re-accumulates identically.
+            run_steps(t2, 3)
+            np.testing.assert_array_equal(
+                np.asarray(t2.state.sel_counts), want_final)
+        finally:
+            t2.close()
+
+    def test_pre_ledger_checkpoint_restores_with_fresh_zeros(
+            self, mesh, tmp_path):
+        """Upgrade shim: a checkpoint written with ``telemetry=False``
+        (no ``sel_counts`` entry) restores into a ledger-bearing trainer
+        via the elastic path — params carry, the ledger starts at
+        zero."""
+        old = Trainer(table_cfg(telemetry=False, steps_per_epoch=4,
+                                checkpoint_dir=str(tmp_path)), mesh=mesh)
+        try:
+            run_steps(old, 2)
+            old.save()
+            want = np.asarray(
+                jax.tree_util.tree_leaves(old.state.params)[0])
+        finally:
+            old.close()
+
+        t = Trainer(table_cfg(steps_per_epoch=4,
+                              checkpoint_dir=str(tmp_path)), mesh=mesh)
+        try:
+            assert t.restore_elastic() == 2
+            got = np.asarray(jax.tree_util.tree_leaves(t.state.params)[0])
+            np.testing.assert_array_equal(want, got)
+            counts = np.asarray(t.state.sel_counts)
+            assert counts.shape[0] == 4
+            assert int(counts.sum()) == 0
+            run_steps(t, 1)  # the fresh ledger accumulates from here
+            assert int(np.asarray(t.state.sel_counts).sum()) == (
+                4 * t.config.batch_size)
+        finally:
+            t.close()
+
+
+@pytest.mark.slow  # parallelism-matrix compile cost (test_elastic.py tier)
+class TestLedgerElastic:
+    def test_shrink_w8_to_w4_carries_exact_per_sample_counts(
+            self, tmp_path):
+        """W=8 → W′=4 ``restore_elastic``: the GLOBAL per-sample counts
+        (cyclic-tiling duplicates summed) carry exactly — the additive
+        carry, not the scores' last-wins."""
+        t1 = Trainer(table_cfg(world_size=8, steps_per_epoch=5,
+                               checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(8))
+        try:
+            run_steps(t1, 5)
+            t1.save()
+            n = int(np.asarray(t1.dataset.y_train).size)
+            want_global = ledger_global_counts(
+                np.asarray(t1.state.sel_counts),
+                np.asarray(t1.dataset.shard_indices), n)
+        finally:
+            t1.close()
+        assert int(want_global.sum()) == 5 * 8 * 8  # steps · W · batch
+
+        t2 = Trainer(table_cfg(world_size=4, steps_per_epoch=5,
+                               checkpoint_dir=str(tmp_path)),
+                     mesh=host_cpu_mesh(4))
+        try:
+            assert t2.restore_elastic() == 5
+            got_global = ledger_global_counts(
+                np.asarray(t2.state.sel_counts),
+                np.asarray(t2.dataset.shard_indices), n)
+            np.testing.assert_array_equal(got_global, want_global)
+        finally:
+            t2.close()
+
+
+class TestHeartbeatAndTolerances:
+    def test_is_active_in_heartbeat_and_tolerances(self):
+        from mercury_tpu.obs.writer import HeartbeatSink
+
+        assert "sampler/is_active" in HeartbeatSink._KEYS
+        tol_path = os.path.join(
+            os.path.dirname(__file__), os.pardir, "mercury_tpu", "obs",
+            "report_tolerances.json")
+        rules = json.load(open(tol_path))["rules"]
+        assert "sampler/is_active" in rules
+        assert rules["sampler_dist/gini"]["direction"] == "lower_better"
+        assert (rules["sampler_dist/frac_never_selected"]["direction"]
+                == "lower_better")
