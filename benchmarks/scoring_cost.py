@@ -25,10 +25,24 @@ plan's in-graph scoring cost is exactly zero by construction, pinned by
 the graftlint ``async`` budget), with the background fleet live during
 the timed loop so the number includes any host-thread interference.
 
+``--mode device`` is the scorer-service headline: uniform vs the
+host-thread fleet vs ``scorer_backend="device"`` (the scoring program
+on its own mesh slice — on CPU the two-program degradation). Besides
+step wall-clock it measures each backend's scoring CAPACITY — rows/s
+sustained through a snapshot+drain saturation loop with the step
+program idle, each backend at its shippable pacing: the host fleet
+duty-cycle-throttled (``--scorer-throttle``; a single-core box cannot
+hide an unthrottled scorer thread, which is the whole motivation), the
+device backend snapshot-paced (every snapshot opens a bounded epoch, so
+a saturating snapshot stream exposes the program's full rate). The
+acceptance bar: device capacity >= 2x the host fleet's with the step
+program still within 2% of uniform.
+
 Usage::
 
     python benchmarks/scoring_cost.py [--steps 30] [--refresh-size 64]
     python benchmarks/scoring_cost.py --mode async
+    python benchmarks/scoring_cost.py --mode device
 
 Appends one JSON record to ``benchmarks/results_scoring_cost.jsonl``.
 """
@@ -100,16 +114,31 @@ def scoring_flops(trainer, n: int):
     return float(costs.get("flops", float("nan")))
 
 
-def _segment(label, trainer, n, counters) -> float:
+def _segment(label, trainer, n, counters, scored=None) -> float:
     """One fenced timed segment of ``n`` steps; returns steps/sec.
 
     Drives ``trainer.state`` (not a local copy) so the async fleet's
     between-step apply tick composes: under ``refresh_mode="async"`` the
     timed loop includes draining scored chunks into the table — the
-    realistic steady-state cost, not a fleet-paused best case."""
+    realistic steady-state cost, not a fleet-paused best case. When
+    ``scored`` is given, the arm's rows-scored delta over ITS OWN timed
+    window is accumulated there — the scorer-throughput measure (rows
+    scored while other arms run are interference, not throughput)."""
     ds = trainer.dataset
     step_fn = trainer.train_step
     fleet = getattr(trainer, "_scorer_fleet", None)
+    # Untimed switch warmup: the first steps after an arm switch pay an
+    # executable/cache re-warm transient that scales with program size —
+    # charging it to the timed window biases against the bigger-program
+    # arms (the scoretable step carries the decay+draw+scatter ops).
+    for _ in range(3):
+        trainer.state, metrics = step_fn(
+            trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+        counters[label] += 1
+        if fleet is not None:
+            trainer._async_refresh_tick(counters[label])
+    np.asarray(metrics["train/loss"])
+    rows0 = fleet.summary()["rows_scored"] if fleet is not None else 0
     t0 = time.perf_counter()
     for _ in range(n):
         trainer.state, metrics = step_fn(
@@ -118,27 +147,59 @@ def _segment(label, trainer, n, counters) -> float:
         if fleet is not None:
             trainer._async_refresh_tick(counters[label])
     np.asarray(metrics["train/loss"])
-    return n / (time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    if scored is not None and fleet is not None:
+        acc = scored.setdefault(label, [0, 0.0])
+        acc[0] += fleet.summary()["rows_scored"] - rows0
+        acc[1] += dt
+    return n / dt
 
 
-def measure_all(trainers, args):
-    """Best-of-``reps`` over INTERLEAVED timed segments.
+def scorer_capacity(trainer, seconds: float = 2.0) -> float:
+    """Sustained scoring capacity (rows/s) with the step program idle.
+
+    Drives the scorer the way a saturating consumer would: re-snapshot
+    (which for the device backend opens a fresh bounded epoch and pays
+    the params-RPC each time) and drain in a tight loop, then count the
+    rows scored. The host fleet runs at its shippable duty cycle (the
+    throttle is part of the configuration under test — unthrottled it
+    cannot coexist with the step loop at all on one core); the device
+    program has no throttle to hide behind, so this is its real rate."""
+    fleet = trainer._scorer_fleet
+    rows0 = fleet.summary()["rows_scored"]
+    t0 = time.perf_counter()
+    i = 0
+    while time.perf_counter() - t0 < seconds:
+        i += 1
+        fleet.snapshot(trainer.state.params, trainer.state.batch_stats, i)
+        time.sleep(0.02)
+        fleet.drain()
+    return (fleet.summary()["rows_scored"] - rows0) / (
+        time.perf_counter() - t0)
+
+
+def measure_all(trainers, args, scored=None):
+    """``reps`` rounds of INTERLEAVED timed segments; returns the
+    per-round steps/s for every arm.
 
     One sequential pass per arm (the is_cost_ladder protocol) is fine
-    for the ladder's coarse ordering, but the async headline is a ≤2%
-    claim — slow drift between arms (CPU frequency scaling, noisy
+    for the ladder's coarse ordering, but the async/device headline is a
+    ≤2% claim — slow drift between arms (CPU frequency scaling, noisy
     container neighbors; observed 60% swings run-to-run) would dwarf it.
-    Alternating short segments exposes every arm to the same drift, and
-    best-of is the least-interference estimate of each arm's step time."""
+    Within a ROUND the arms run back-to-back (sub-second apart), so the
+    caller forms per-round ratios against uniform and takes the median
+    across rounds: pairing cancels the drift, the median rejects rounds
+    where a scorer burst or a neighbor spike landed in one window."""
     counters = {label: 0 for label in trainers}
     for label, tr in trainers.items():   # compile + warmup, untimed
         _segment(label, tr, 3, counters)
-    best = {label: 0.0 for label in trainers}
+    rounds = []
     for _ in range(args.reps):
-        for label, tr in trainers.items():
-            best[label] = max(best[label],
-                              _segment(label, tr, args.steps, counters))
-    return best
+        rounds.append({
+            label: _segment(label, tr, args.steps, counters, scored)
+            for label, tr in trainers.items()
+        })
+    return rounds
 
 
 def main(argv=None) -> int:
@@ -152,9 +213,19 @@ def main(argv=None) -> int:
                     help="steps per timed segment")
     ap.add_argument("--reps", type=int, default=3,
                     help="interleaved timed segments per arm (best-of)")
-    ap.add_argument("--mode", choices=("full", "async"), default="full",
+    ap.add_argument("--mode", choices=("full", "async", "device"),
+                    default="full",
                     help="async: uniform vs the async scorer fleet only "
-                         "(CI smoke for the off-step refresh headline)")
+                         "(CI smoke for the off-step refresh headline); "
+                         "device: uniform vs host fleet vs the "
+                         "device-backend scorer service, with per-arm "
+                         "scorer rows/s")
+    ap.add_argument("--device-snapshot-every", type=int, default=32,
+                    help="snapshot_every for the device arm: the device "
+                         "backend is snapshot-paced (a queue's worth of "
+                         "chunks per params RPC), so this is its duty-"
+                         "cycle knob — the device-side analogue of "
+                         "--scorer-throttle")
     ap.add_argument("--scorer-throttle", type=float, default=0.5,
                     help="scorer_throttle_s for the async arm: on a "
                          "single-core CPU smoke an unthrottled fleet "
@@ -191,6 +262,15 @@ def main(argv=None) -> int:
                   "scorer_throttle_s": args.scorer_throttle})
     if args.mode == "async":
         arms = [("uniform", {"use_importance_sampling": False}), async_arm]
+    elif args.mode == "device":
+        arms = [
+            ("uniform", {"use_importance_sampling": False}),
+            async_arm,
+            ("is_scoretable_device",
+             {"sampler": "scoretable", "refresh_mode": "async",
+              "scorer_backend": "device", "scorer_throttle_s": 0.0,
+              "snapshot_every": args.device_snapshot_every}),
+        ]
     else:
         arms = [
             ("uniform", {"use_importance_sampling": False}),
@@ -208,18 +288,61 @@ def main(argv=None) -> int:
             print(f"# arm {label} failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
             results[label] = None
-    measured = measure_all(trainers, args)
+    scored = {} if args.mode == "device" else None
+    rounds = measure_all(trainers, args, scored)
+    # Headline steps/s and vs_uniform: per-arm best across rounds (the
+    # committed-record protocol — each arm at its least-interfered
+    # window; scheduler noise on a shared box otherwise dwarfs a 2%
+    # claim). The paired per-round median is kept alongside as the
+    # drift-cancelling cross-check.
+    measured = {
+        label: max(r[label] for r in rounds)
+        for label in trainers
+    }
+    ratios_paired = {
+        label: round(float(np.median(
+            [r[label] / r["uniform"] for r in rounds])), 3)
+        for label in trainers
+    } if "uniform" in trainers else None
+    capacity = None
+    if args.mode == "device":
+        capacity = {
+            label: round(scorer_capacity(tr), 1)
+            for label, tr in trainers.items()
+            if getattr(tr, "_scorer_fleet", None) is not None
+        }
     for label, tr in trainers.items():
         tr.close()
     for label, sps in measured.items():
         results[label] = round(sps, 2) if sps else None
         print(f"# {label}: {results[label]} steps/s", file=sys.stderr)
+    scorer_rows = None
+    device_vs_host = None
+    if scored:
+        scorer_rows = {
+            label: round(rows / secs, 1) if secs else None
+            for label, (rows, secs) in scored.items()
+        }
+        for label, rps in scorer_rows.items():
+            print(f"# {label}: {rps} scored rows/s in-step", file=sys.stderr)
+    if capacity:
+        for label, rps in capacity.items():
+            print(f"# {label}: {rps} scored rows/s capacity",
+                  file=sys.stderr)
+        host_rps = capacity.get("is_scoretable_async")
+        dev_rps = capacity.get("is_scoretable_device")
+        if host_rps and dev_rps:
+            device_vs_host = round(dev_rps / host_rps, 2)
+            print(f"# device scorer capacity vs host fleet: "
+                  f"{device_vs_host}x", file=sys.stderr)
 
     uniform = results.get("uniform")
     record = {
         "schema": "scoring_cost_v1",
         "mode": args.mode,
         "scorer_throttle_s": args.scorer_throttle,
+        "device_snapshot_every": (
+            args.device_snapshot_every if args.mode == "device" else None),
         "model": args.model,
         "dataset": args.dataset,
         "batch_size": args.batch_size,
@@ -227,6 +350,11 @@ def main(argv=None) -> int:
         "refresh_size": args.refresh_size,
         "platform": dev.platform,
         "device_kind": dev.device_kind,
+        # Contention context: with one host core the scorer's dispatch
+        # AND compute share the training core (the CPU two-program
+        # degradation), so the vs-uniform ratio carries scheduler noise
+        # a dedicated scorer slice does not have.
+        "host_cpus": os.cpu_count(),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "scoring_flops_per_step": {
             "pool": flops_pool,
@@ -234,10 +362,14 @@ def main(argv=None) -> int:
             "reduction": round(flops_ratio, 2) if flops_ratio else None,
         },
         "steps_per_sec": results,
+        "scorer_rows_per_sec_in_step": scorer_rows,
+        "scorer_capacity_rows_per_sec": capacity,
+        "device_vs_host_throughput": device_vs_host,
         "vs_uniform": {
             label: (round(v / uniform, 3) if (v and uniform) else None)
             for label, v in results.items()
         },
+        "vs_uniform_paired_median": ratios_paired,
     }
     with open(args.out, "a") as f:
         f.write(json.dumps(record) + "\n")

@@ -227,6 +227,47 @@ class TrainConfig:
     # the compute the step needs; a throttle trades refresh rate for step
     # time, and the table's age-decay absorbs the extra staleness.
     scorer_throttle_s: float = 0.0
+    # Async refresh only: WHERE the scoring program runs.
+    # - "host": the PR-8 fleet — vmapped scoring forwards jitted onto the
+    #   default placement, driven by host threads (scorer_throttle_s
+    #   paces the duty cycle).
+    # - "device": the scoring forward is its own pjit program compiled
+    #   onto a dedicated mesh slice (parallel/mesh.py
+    #   reserve_scorer_slice: spare devices when any exist, else a second
+    #   program on the training mesh's devices — the CPU two-program
+    #   degradation). Params reach the slice by snapshot RPC
+    #   (device_put), and scoring is snapshot-paced: each params push
+    #   triggers at most a queue's worth of chunk scorings, so the duty
+    #   cycle is bounded by snapshot_every and scorer_throttle_s is
+    #   meaningless (validated to 0). The chunk protocol — (slots,
+    #   scores, snapshot_step) over the bounded queue — is unchanged, so
+    #   apply_async_chunk and the staleness weighting are reused
+    #   verbatim and the applies are bit-identical to the host backend
+    #   at equal snapshot age (test-enforced).
+    scorer_backend: str = "host"
+    # Scorer service tenancy: >1 runs the ScorerService front
+    # (sampling/scorer_service.py) with per-tenant bounded queues and
+    # weighted-fair chunk scheduling. Tenant 0 feeds THIS trainer's
+    # table; extra tenants model co-hosted scoring consumers and are
+    # drained/discarded by the trainer after accounting (their telemetry
+    # streams under scorer/*/t{i}). 1..4.
+    scorer_tenants: int = 1
+    # Comma-separated per-tenant drain weights ("2,1": tenant 0 gets 2/3
+    # of scored chunks). "" = equal weights. len must equal
+    # scorer_tenants; entries > 0.
+    scorer_tenant_weights: str = ""
+    # Scorer-service SLO: max tolerated score staleness (steps between a
+    # tenant's latest delivered chunk's snapshot and the current step)
+    # before the supervisor walks the ladder one level (async → sync →
+    # frozen → uniform). 0 disables. Arm at a few multiples of
+    # snapshot_every: staleness persistently above that means the
+    # service has wedged or starved.
+    slo_score_staleness_max: int = 0
+    # Scorer-service SLO: queue-depth high-water. A tenant's ready queue
+    # sitting at or above this depth when the supervisor ticks means the
+    # consumer stopped draining (backpressure breach) — same ladder
+    # walk. 0 disables.
+    scorer_queue_highwater: int = 0
     # Optional dtype override for the SCORING forward only (scores only
     # rank, so bf16 scoring is safe even when training compute is f32) —
     # e.g. "bfloat16" halves the refresh forward's bandwidth. None = score

@@ -35,6 +35,10 @@ Fault kinds and their hook points:
                     refresh, when the ladder has degraded that far)
 ``scorer_nan``      ``ScorerFleet._next_chunk`` corrupts the chunk's
                     scores to NaN (the trainer's apply guard rejects it)
+``scorer_wedge``    ``ScorerService`` marks tenant ``tenant`` (default 0)
+                    wedged: it stops scheduling that tenant's chunks, so
+                    its staleness grows until the service SLO
+                    (``slo_score_staleness_max``) walks the ladder
 ``prefetch_die``    ``PrefetchPipeline._prefetch_loop`` raises
 ``prefetch_stall``  the prefetch worker sleeps ``secs`` before gathering
 ``sink_wedge``      the metric drain thread sleeps ``secs`` mid-emit
@@ -61,6 +65,7 @@ __all__ = ["FaultPlane", "InjectedFault", "KNOWN_KINDS", "parse_fault_spec"]
 KNOWN_KINDS = frozenset({
     "scorer_die",
     "scorer_nan",
+    "scorer_wedge",
     "prefetch_die",
     "prefetch_stall",
     "sink_wedge",

@@ -53,7 +53,7 @@ from mercury_tpu.lint import golden
 
 SCHEMA = "graftlint_budgets_v1"
 PLAN_NAMES = ("dp", "zero", "dp_bf16", "hs", "hs_local", "hs_fused", "sp",
-              "pp", "async")
+              "pp", "async", "device_scorer")
 
 # The seed step's metric surface — what telemetry=False must reproduce
 # exactly (mirrors benchmarks/telemetry_overhead.py::BASE_KEYS).
@@ -328,6 +328,47 @@ def _build_async():
     return trainer.train_step, args, dict(kw, plan="async")
 
 
+def _build_device_scorer():
+    """The device-backed scorer service (``scorer_backend="device"``):
+    rescoring runs as its OWN pjit program on the reserved scorer slice
+    (CPU two-program degradation here), so the TRAINER's fused step must
+    stay exactly as scoring-free as the ``async`` plan's — the budget
+    pins that moving the scoring program onto a device slice changed
+    nothing about the hot program. The trainer's service is closed
+    immediately, like the async plan's fleet."""
+    from mercury_tpu.config import TrainConfig
+    from mercury_tpu.parallel.mesh import make_mesh
+    from mercury_tpu.train.trainer import Trainer
+
+    kw: Dict[str, Any] = dict(
+        model="smallcnn",
+        dataset="synthetic",
+        world_size=2,
+        batch_size=8,
+        presample_batches=2,
+        sampler="scoretable",
+        refresh_mode="async",
+        scorer_backend="device",
+        scorer_workers=1,
+        snapshot_every=4,
+        num_epochs=1,
+        steps_per_epoch=100,
+        eval_every=0,
+        log_every=0,
+        scan_steps=1,
+        compute_dtype="float32",
+        telemetry=False,
+        heartbeat_every=0,
+        seed=0,
+    )
+    config = TrainConfig(**kw)
+    trainer = Trainer(config, mesh=make_mesh(2, config.mesh_axis))
+    trainer._scorer_fleet.close()
+    ds = trainer.dataset
+    args = (trainer.state, ds.x_train, ds.y_train, ds.shard_indices)
+    return trainer.train_step, args, dict(kw, plan="device_scorer")
+
+
 def _build_hs(shard_mode: str = None):
     """host_stream dp: the lookahead step (``hs_body``) — pixels arrive
     as a streamed uint8 batch, the next selection's indices leave as a
@@ -498,6 +539,7 @@ _BUILDERS = {
     "sp": _build_sp,
     "pp": _build_pp,
     "async": _build_async,
+    "device_scorer": _build_device_scorer,
 }
 
 
@@ -530,17 +572,17 @@ def check_invariants(m: PlanMeasurement) -> List[str]:
             "inside the mercury_scoring scope with "
             "scoring_dtype=bfloat16 (expected 0: a silent upcast erases "
             "the scoring FLOP savings)")
-    if m.plan == "async":
+    if m.plan in ("async", "device_scorer"):
         if m.scoring_ops != 0:
             errors.append(
-                f"plan async: {m.scoring_ops} dot/conv op(s) inside the "
-                "mercury_scoring scope with refresh_mode=async (expected "
-                "0: the scorer fleet owns the refresh — scoring compute "
-                "in the hot program is the regression this plan exists "
-                "to catch)")
+                f"plan {m.plan}: {m.scoring_ops} dot/conv op(s) inside "
+                "the mercury_scoring scope with refresh_mode=async "
+                "(expected 0: the scorer fleet/service owns the refresh "
+                "— scoring compute in the hot program is the regression "
+                "this plan exists to catch)")
         if m.scoped_collectives.get("mercury_scoring"):
             errors.append(
-                "plan async: collectives inside the mercury_scoring "
+                f"plan {m.plan}: collectives inside the mercury_scoring "
                 f"scope {m.scoped_collectives['mercury_scoring']} with "
                 "refresh_mode=async (expected none: no scoring forward, "
                 "no scoring collectives)")

@@ -85,6 +85,7 @@ HOT_THREAD_MODULES = (
     "mercury_tpu/obs/anomaly.py",
     "mercury_tpu/runtime/supervisor.py",
     "mercury_tpu/sampling/scorer_fleet.py",
+    "mercury_tpu/sampling/scorer_service.py",
     "mercury_tpu/train/checkpoint.py",
     "mercury_tpu/train/trainer.py",
 )

@@ -273,7 +273,7 @@ GL133 = _rule(
 # anything that does), so the set is duplicated here; Layer 3's audit
 # cross-checks the two at every run (lint/sharding.py
 # check_axis_registry), so drift cannot persist.
-_MESH_AXES = ("data", "model", "seq", "pipe")
+_MESH_AXES = ("data", "model", "seq", "pipe", "scorer")
 
 
 # --------------------------------------------------------------------------

@@ -119,8 +119,35 @@ METRIC_KEYS: Dict[str, str] = {
     "data/stall_s": "input-attributable pop() wait since the last log tick",
     "data/queue_depth": "committed prefetch batches ready at log time",
     "data/h2d_bytes": "staged host-to-device bytes since the last log tick",
-    # scorer/* — the async scorer fleet (sampling/scorer_fleet.py)
+    # scorer/* — the async scorer fleet (sampling/scorer_fleet.py) and
+    # the scorer service front (sampling/scorer_service.py). The
+    # service emits the aggregates plus one stream per tenant t0..t3
+    # (scorer_tenants is capped at 4 so the per-tenant keys stay an
+    # exact-match enumeration).
     "scorer/throughput": "async refresh: rows scored per second by the fleet",
+    "scorer/queue_depth":
+        "scorer service: ready chunks queued across all tenants",
+    "scorer/staleness":
+        "scorer service: max tenant staleness, steps since the latest "
+        "delivered chunk's snapshot",
+    "scorer/slo_breaches":
+        "scorer service: cumulative SLO breach events across tenants",
+    "scorer/throughput/t0": "scorer service: tenant 0 rows per second",
+    "scorer/throughput/t1": "scorer service: tenant 1 rows per second",
+    "scorer/throughput/t2": "scorer service: tenant 2 rows per second",
+    "scorer/throughput/t3": "scorer service: tenant 3 rows per second",
+    "scorer/queue_depth/t0": "scorer service: tenant 0 ready-queue depth",
+    "scorer/queue_depth/t1": "scorer service: tenant 1 ready-queue depth",
+    "scorer/queue_depth/t2": "scorer service: tenant 2 ready-queue depth",
+    "scorer/queue_depth/t3": "scorer service: tenant 3 ready-queue depth",
+    "scorer/staleness/t0": "scorer service: tenant 0 staleness (steps)",
+    "scorer/staleness/t1": "scorer service: tenant 1 staleness (steps)",
+    "scorer/staleness/t2": "scorer service: tenant 2 staleness (steps)",
+    "scorer/staleness/t3": "scorer service: tenant 3 staleness (steps)",
+    "scorer/slo_breaches/t0": "scorer service: tenant 0 SLO breach events",
+    "scorer/slo_breaches/t1": "scorer service: tenant 1 SLO breach events",
+    "scorer/slo_breaches/t2": "scorer service: tenant 2 SLO breach events",
+    "scorer/slo_breaches/t3": "scorer service: tenant 3 SLO breach events",
     # obs/* — the metric stream observing itself
     "obs/dropped": "cumulative records dropped by the bounded queue",
     # anomaly/* — flight-recorder health accounting
@@ -175,6 +202,8 @@ METRIC_KEYS: Dict[str, str] = {
     "supervisor/degradations": "cumulative one-level ladder descents",
     "supervisor/recoveries": "cumulative one-level ladder ascents",
     "supervisor/units_down": "registered units currently failing liveness",
+    "supervisor/slo_breaches":
+        "cumulative registered-SLO breach events (rising edges)",
     # checkpoint/* — durable checkpoint writer (train/checkpoint.py)
     "checkpoint/write_failures":
         "cumulative failed checkpoint write attempts (retries included)",

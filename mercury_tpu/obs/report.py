@@ -240,6 +240,51 @@ def _sampler_health_blocks(records: Sequence[Dict[str, Any]]
     return blocks
 
 
+# ------------------------------------------------- scorer-service section
+def _scorer_service_blocks(records: Sequence[Dict[str, Any]]
+                           ) -> List[Block]:
+    """The "Scorer service" section: service aggregates plus the
+    per-tenant throughput/backpressure/SLO table
+    (``scorer/{throughput,queue_depth,staleness,slo_breaches}/t{i}``).
+    Empty when the run used the plain fleet or no async scorer at all
+    (the service keys are absent)."""
+    blocks: List[Block] = []
+    agg = []
+    for key, label in (
+            ("scorer/throughput", "rows scored / s"),
+            ("scorer/queue_depth", "ready chunks queued"),
+            ("scorer/staleness", "max tenant staleness (steps)"),
+            ("scorer/slo_breaches", "SLO breach events")):
+        s = summarize_metric(records, key)
+        if s is not None:
+            agg.append((label, _fmt(s["last"])))
+    tenants = []
+    for i in range(4):
+        tput = summarize_metric(records, f"scorer/throughput/t{i}")
+        if tput is None:
+            continue
+        depth = summarize_metric(records, f"scorer/queue_depth/t{i}")
+        stale = summarize_metric(records, f"scorer/staleness/t{i}")
+        slo = summarize_metric(records, f"scorer/slo_breaches/t{i}")
+        tenants.append([
+            f"t{i}", _fmt(tput["last"]), _fmt(tput["mean_tail"]),
+            _fmt(depth["last"]) if depth else "-",
+            _fmt(stale["last"]) if stale else "-",
+            _fmt(slo["last"]) if slo else "-"])
+    if not tenants:
+        # Aggregates without tenant streams = the plain fleet; the
+        # Metrics table already covers scorer/throughput there.
+        return blocks
+    blocks.append(("h", 2, "Scorer service"))
+    if agg:
+        blocks.append(("kv", agg))
+    blocks.append(("table",
+                   ["tenant", "rows/s (last)",
+                    f"rows/s (mean last {_DEFAULT_WINDOW})",
+                    "queue depth", "staleness", "slo breaches"], tenants))
+    return blocks
+
+
 # ------------------------------------------------------------ rendering
 # Reports are built as a neutral block list so markdown and HTML render
 # from the same structure: ("h", level, text) | ("p", text) |
@@ -284,6 +329,7 @@ def _run_blocks(run: Dict[str, Any]) -> List[Block]:
                        ["metric", "last", f"mean(last {_DEFAULT_WINDOW})",
                         "min", "max", "n"], rows))
         blocks.extend(_sampler_health_blocks(records))
+        blocks.extend(_scorer_service_blocks(records))
     if run["shards"]:
         blocks.append(("h", 2, "Per-host shards"))
         rows = []

@@ -407,7 +407,8 @@ class HeartbeatSink:
     _KEYS = ("train/loss", "train/acc", "perf/steps_per_s",
              "perf/examples_per_s", "perf/mfu", "sampler/ess",
              "sampler/is_active", "data/stall_s", "obs/dropped",
-             "anomaly/triggers")
+             "anomaly/triggers", "scorer/throughput", "scorer/staleness",
+             "scorer/slo_breaches")
 
     def __init__(self, every_steps: int = 100, min_interval_s: float = 1.0,
                  stream=None) -> None:

@@ -22,7 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 #: Layer 3 sharding audit fails if the mirror drifts from this tuple.
 #: Adding a new axis (e.g. an expert axis) means adding it HERE and to
 #: the mirror — one commit, both layers.
-MESH_AXES = ("data", "model", "seq", "pipe")
+MESH_AXES = ("data", "model", "seq", "pipe", "scorer")
 
 #: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
 #: what each helper here promises about placements.
@@ -104,6 +104,40 @@ def replicate(mesh: Mesh, tree):
     """Device-put a pytree fully replicated over the mesh."""
     sharding = replicated_sharding(mesh)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def reserve_scorer_slice(train_mesh: Mesh) -> Sequence[jax.Device]:
+    """Devices for the scorer service's dedicated slice.
+
+    Preference order (``scorer_backend="device"``):
+
+    1. **Spare devices** — any addressable device NOT in the training
+       mesh. On a pod this is the reserved sub-mesh (carve the training
+       mesh over ``N-k`` devices and the scorer program owns the other
+       ``k``); in a multi-process deployment it is the spare process
+       group's devices.
+    2. **Degraded two-program mode** — no spares (the CI/CPU path, and
+       any run that meshes every device): the scorer program reuses the
+       training mesh's own devices as a SECOND compiled program. Overlap
+       is lost but the architecture — separate program, params pushed by
+       snapshot RPC, chunks returned over the bounded queue — is
+       identical, which is what makes the device backend tier-1-testable
+       without a pod.
+    """
+    train_ids = {d.id for d in train_mesh.devices.flat}
+    spares = [d for d in jax.devices() if d.id not in train_ids]
+    if spares:
+        return spares
+    return list(train_mesh.devices.flat)
+
+
+def make_scorer_mesh(train_mesh: Mesh,
+                     axis_name: str = "scorer") -> Mesh:
+    """1-D mesh over the reserved scorer slice
+    (:func:`reserve_scorer_slice`) — the placement target of the scorer
+    service's pjit program and its params snapshots."""
+    return make_mesh(axis_name=axis_name,
+                     devices=reserve_scorer_slice(train_mesh))
 
 
 def host_cpu_mesh(n: int = 8, axis_name: str = "data") -> Mesh:

@@ -58,6 +58,19 @@ __all__ = ["HostSupervisor", "LEVEL_NAMES"]
 LEVEL_NAMES = ("async", "sync", "frozen", "uniform")
 
 
+class _Slo:
+    """One registered service-level objective (mutable breach latch)."""
+
+    __slots__ = ("name", "check_fn", "breached", "breaches")
+
+    def __init__(self, name: str,
+                 check_fn: Callable[[], Optional[str]]) -> None:
+        self.name = name
+        self.check_fn = check_fn
+        self.breached = False   # rising-edge latch: one degrade per event
+        self.breaches = 0
+
+
 class _Unit:
     """One supervised thread fleet (mutable restart state)."""
 
@@ -100,6 +113,7 @@ class HostSupervisor:
         self._probe_every = max(int(probe_every), 0)
         self._anomaly = anomaly
         self._units: List[_Unit] = []
+        self._slos: List[_Slo] = []
         self._probe_fn: Optional[Callable[[], None]] = None
         self._revive_fn: Optional[Callable[[], None]] = None
         # One lock guards all mutable supervisor state: tick() (trainer
@@ -134,6 +148,20 @@ class HostSupervisor:
         training cannot proceed without input)."""
         with self._lock:
             self._units.append(_Unit(name, alive, restart, escalates))
+
+    def register_slo(self, name: str,
+                     check: Callable[[], Optional[str]]) -> None:
+        """Register a service-level objective. ``check`` returns a
+        breach description while the SLO is violated and None while
+        healthy; it is evaluated every :meth:`tick`. A breach walks the
+        degradation ladder ONE level on its rising edge (latched — a
+        persistent breach does not free-fall to uniform; clearing and
+        re-breaching walks another level, and the recovery probe climbs
+        back when the plane heals). The scorer service's backpressure +
+        staleness SLOs (``slo_score_staleness_max``,
+        ``scorer_queue_highwater``) enter the ladder here."""
+        with self._lock:
+            self._slos.append(_Slo(name, check))
 
     def set_ladder(self, probe: Callable[[], None],
                    revive: Callable[[], None]) -> None:
@@ -174,7 +202,31 @@ class HostSupervisor:
                     unit.down_since_t = None
                 continue
             self._handle_down(unit, step, now)
+        self._check_slos(step)
         self._maybe_probe(step)
+
+    def _check_slos(self, step: int) -> None:
+        with self._lock:
+            slos = list(self._slos)
+        for slo in slos:
+            try:
+                status = slo.check_fn()
+            except Exception as exc:
+                _log.warning("supervisor: SLO check %s raised: %s",
+                             slo.name, exc)
+                continue
+            with self._lock:
+                rising = status is not None and not slo.breached
+                slo.breached = status is not None
+                if rising:
+                    slo.breaches += 1
+            if rising:
+                _log.warning("supervisor: SLO %s breached at step %d: %s",
+                             slo.name, step, status)
+                self._flight("supervisor_slo_breach", step, {
+                    "slo": slo.name, "status": status,
+                })
+                self._degrade(step, f"SLO {slo.name} breached: {status}")
 
     def request_restart(self, name: str, step: int) -> bool:
         """Synchronous restart of one unit (the pop()-failed hot path:
@@ -327,8 +379,13 @@ class HostSupervisor:
 
     def _maybe_probe(self, step: int) -> None:
         with self._lock:
+            # A still-breaching SLO pins the ladder: climbing back while
+            # e.g. scorer staleness is over its max would oscillate
+            # (recover, re-breach, degrade) without the plane having
+            # healed — recovery waits for every SLO to clear.
+            slo_pinned = any(s.breached for s in self._slos)
             due = (self._level > 0 and self._probe_every > 0
-                   and step >= self._next_probe_step)
+                   and not slo_pinned and step >= self._next_probe_step)
             if due:
                 self._next_probe_step = step + self._probe_every
             probe = self._probe_fn
@@ -413,6 +470,8 @@ class HostSupervisor:
                 "supervisor/degradations": float(self._degradations),
                 "supervisor/recoveries": float(self._recoveries),
                 "supervisor/units_down": float(down),
+                "supervisor/slo_breaches": float(
+                    sum(s.breaches for s in self._slos)),
                 "sampler/is_active": 0.0 if self._level >= 3 else 1.0,
             }
 
@@ -432,5 +491,10 @@ class HostSupervisor:
                     {"name": u.name, "restarts_used": u.restarts_used,
                      "down": u.down_since_t is not None}
                     for u in self._units
+                ],
+                "slos": [
+                    {"name": s.name, "breached": s.breached,
+                     "breaches": s.breaches}
+                    for s in self._slos
                 ],
             }

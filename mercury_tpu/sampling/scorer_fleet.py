@@ -32,6 +32,23 @@ Telemetry: ``scorer/throughput``, ``sampler/refresh_lag_chunks``,
 
 Single-controller only, like the prefetch pipeline: the fleet scores
 from one host's copy of the dataset.
+
+PR 16 factors the scoring computation itself out into
+:class:`ScoringProgram`, which owns WHERE the forward runs:
+
+- ``backend="host"`` — the original fleet program: ``jax.jit`` on the
+  default placement, identity-jit param snapshots. ``ScorerFleet``
+  always uses this backend and is behaviorally unchanged.
+- ``backend="device"`` — the forward is compiled as its own pjit
+  program onto the dedicated scorer slice
+  (``parallel/mesh.reserve_scorer_slice``), with params pushed to the
+  slice by snapshot RPC (explicit ``device_put``). Consumed by the
+  :class:`~mercury_tpu.sampling.scorer_service.ScorerService` front,
+  which also adds multi-tenant queues and backpressure SLOs.
+
+Both backends emit the SAME :class:`ScoreChunk` protocol, and the
+per-row vmap has no cross-row math, so device-backend scores are
+bit-identical to host-backend scores from the same snapshot.
 """
 
 from __future__ import annotations
@@ -56,6 +73,152 @@ from mercury_tpu.sampling.importance import (
 from mercury_tpu.utils.logging import get_logger
 
 _log = get_logger("mercury_tpu.sampling.scorer_fleet")
+
+
+class ScoringProgram:
+    """The scoring forward + its placement, factored out of the fleet so
+    the host-thread fleet and the device-backed service compile the SAME
+    math onto different placements.
+
+    - ``backend="host"``: ``jax.jit(score)`` on the default placement
+      (exactly the PR-8 fleet program) and an identity-jit params copy.
+    - ``backend="device"``: the same ``score`` pjit-compiled onto a 1-D
+      ``scorer`` mesh over :func:`~mercury_tpu.parallel.mesh.
+      reserve_scorer_slice` — spare devices when the deployment left
+      any, else the training mesh's own devices (CPU two-program
+      degradation). The worker axis shards over the slice when it
+      divides evenly; params/batch_stats replicate onto the slice via
+      the snapshot RPC (:meth:`snapshot`). The per-row vmap has no
+      cross-row reductions, so sharding the rows cannot change any
+      row's bits — the device backend scores bit-identically to host.
+    """
+
+    def __init__(self, model, mean, std, config: TrainConfig,
+                 n_workers: int, backend: str = "host",
+                 train_mesh=None) -> None:
+        if backend not in ("host", "device"):
+            raise ValueError(
+                f"scorer_backend must be 'host' or 'device', got "
+                f"{backend!r}")
+        self.backend = backend
+        self._model = model
+        self._mean = mean
+        self._std = std
+        self._config = config
+        self._n_workers = int(n_workers)
+
+        if config.augmentation == "noniid":
+            self._augment = lambda k, im: augment_batch(
+                k, im, use_cutout=config.cutout)
+        elif config.augmentation == "iid":
+            from mercury_tpu.data.transforms import augment_batch_iid
+
+            self._augment = augment_batch_iid
+        else:
+            self._augment = lambda k, im: im
+
+        # Identity jit: executable outputs are always fresh XLA-owned
+        # buffers (never aliases of the donated live state) — the same
+        # idiom as Trainer._recommit_state and PrefetchPipeline._commit.
+        self._copy = jax.jit(lambda t: t)
+
+        score = self._build_score()
+        if backend == "host":
+            self.mesh = None
+            self.dedicated = False
+            self.n_slice_devices = 1
+            self._snap_sharding = None
+            self._score_fn = jax.jit(score)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from mercury_tpu.parallel.mesh import make_scorer_mesh
+
+            if train_mesh is None:
+                raise ValueError(
+                    "scorer_backend='device' needs the training mesh to "
+                    "reserve its scorer slice")
+            self.mesh = make_scorer_mesh(train_mesh)
+            slice_ids = {d.id for d in self.mesh.devices.flat}
+            train_ids = {d.id for d in train_mesh.devices.flat}
+            self.dedicated = slice_ids.isdisjoint(train_ids)
+            self.n_slice_devices = self.mesh.size
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            # Shard the worker axis over the slice when it divides
+            # evenly; otherwise replicate (scores stay bit-identical
+            # either way — placement only).
+            if self._n_workers % self.mesh.size == 0:
+                row = NamedSharding(self.mesh, PartitionSpec("scorer"))
+            else:
+                row = rep
+            self._snap_sharding = rep
+            self._score_fn = jax.jit(
+                score,
+                in_shardings=(rep, rep, row, row, rep),
+                out_shardings=row,
+            )
+
+    def _build_score(self):
+        config = self._config
+        model = self._model
+        mean, std = self._mean, self._std
+        n_workers = self._n_workers
+        augment = self._augment
+
+        def score(params, batch_stats, rows, labels, key):
+            # vmap over the worker axis so batch statistics are computed
+            # per worker row — the same normalization granularity the
+            # in-graph per-worker scoring forward sees inside shard_map.
+            def one(rows_w, labels_w, key_w):
+                imgs = normalize_images(rows_w, mean, std)
+                imgs = augment(key_w, imgs)
+                variables = {"params": params}
+                mutable = ["losses"]
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                    mutable = ["batch_stats", "losses"]
+                logits, _ = model.apply(
+                    variables, imgs, train=True, mutable=mutable)
+                logits = logits.astype(jnp.float32)
+                if config.importance_score == "grad_norm":
+                    return per_sample_grad_norm_bound(
+                        logits, labels_w, config.label_smoothing)
+                return per_sample_loss(
+                    logits, labels_w, config.label_smoothing)
+
+            keys = jax.random.split(key, n_workers)
+            # The scope is profiler attribution only — this program is NOT
+            # the fused step, so the Layer-2/3 `async` plan budgets stay
+            # scoring-free; the device-time breakdown still buckets the
+            # fleet's forwards under mercury_scoring.
+            with jax.named_scope("mercury_scoring"):
+                return jax.vmap(one)(rows, labels, keys)
+
+        return score
+
+    def snapshot(self, params, batch_stats):
+        """Copy the live params for this program's placement.
+
+        Host backend: the identity jit alone (fresh XLA-owned buffers,
+        never aliases of the donated live state). Device backend: the
+        same fresh copy, then the snapshot RPC — an explicit
+        ``device_put`` replicating the copy onto the scorer slice, so
+        subsequent score dispatches never pull params across the
+        slice boundary."""
+        snap = self._copy((params, batch_stats))
+        if self._snap_sharding is not None:
+            snap = jax.device_put(snap, self._snap_sharding)
+        return snap
+
+    def __call__(self, params, batch_stats, rows, labels, key):
+        return self._score_fn(params, batch_stats, rows, labels, key)
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "backend": self.backend,
+            "slice_devices": self.n_slice_devices,
+            "dedicated_slice": self.dedicated,
+        }
 
 
 class ScoreChunk(NamedTuple):
@@ -119,26 +282,16 @@ class ScorerFleet:
         # disabled — every hook site below is a plain attribute check.
         self._faults = faults
 
-        if config.augmentation == "noniid":
-            self._augment = lambda k, im: augment_batch(
-                k, im, use_cutout=config.cutout)
-        elif config.augmentation == "iid":
-            from mercury_tpu.data.transforms import augment_batch_iid
-
-            self._augment = augment_batch_iid
-        else:
-            self._augment = lambda k, im: im
-
         # Chunk-id-keyed augmentation stream, disjoint from the step's
         # per-worker rng chains (the fleet's augmentation draws cannot
         # perturb any recorded trajectory).
         self._base_key = jax.random.fold_in(  # graftlint: disable=GL101 -- deliberate sentinel stream 0x5C0 for fleet-side augmentation, disjoint from the training rng chains
             jax.random.key(config.seed), 0x5C0)
-        self._score_fn = self._build_score_fn()
-        # Identity jit: executable outputs are always fresh XLA-owned
-        # buffers (never aliases of the donated live state) — the same
-        # idiom as Trainer._recommit_state and PrefetchPipeline._commit.
-        self._copy = jax.jit(lambda t: t)
+        # The fleet is the HOST backend by construction — the device
+        # backend runs the same ScoringProgram under the ScorerService
+        # front (sampling/scorer_service.py).
+        self._program = ScoringProgram(
+            model, mean, std, config, self._W, backend="host")
 
         # (params, batch_stats, step) — replaced wholesale by snapshot();
         # readers grab the tuple once, so torn reads are impossible.
@@ -182,43 +335,6 @@ class ScorerFleet:
             t.start()
 
     # ------------------------------------------------------------- scoring
-    def _build_score_fn(self):
-        config = self._config
-        model = self._model
-        mean, std = self._mean, self._std
-        n_workers = self._W
-
-        def score(params, batch_stats, rows, labels, key):
-            # vmap over the worker axis so batch statistics are computed
-            # per worker row — the same normalization granularity the
-            # in-graph per-worker scoring forward sees inside shard_map.
-            def one(rows_w, labels_w, key_w):
-                imgs = normalize_images(rows_w, mean, std)
-                imgs = self._augment(key_w, imgs)
-                variables = {"params": params}
-                mutable = ["losses"]
-                if batch_stats:
-                    variables["batch_stats"] = batch_stats
-                    mutable = ["batch_stats", "losses"]
-                logits, _ = model.apply(
-                    variables, imgs, train=True, mutable=mutable)
-                logits = logits.astype(jnp.float32)
-                if config.importance_score == "grad_norm":
-                    return per_sample_grad_norm_bound(
-                        logits, labels_w, config.label_smoothing)
-                return per_sample_loss(
-                    logits, labels_w, config.label_smoothing)
-
-            keys = jax.random.split(key, n_workers)
-            # The scope is profiler attribution only — this program is NOT
-            # the fused step, so the Layer-2/3 `async` plan budgets stay
-            # scoring-free; the device-time breakdown still buckets the
-            # fleet's forwards under mercury_scoring.
-            with jax.named_scope("mercury_scoring"):
-                return jax.vmap(one)(rows, labels, keys)
-
-        return jax.jit(score)
-
     def _next_chunk(self) -> Optional[ScoreChunk]:
         """Score the next round-robin window on the calling thread.
         Public via :meth:`score_once`; the worker loop calls it too."""
@@ -242,7 +358,7 @@ class ScorerFleet:
         rows = self._x[gidx]
         labels = self._y[gidx]
         key = jax.random.fold_in(self._base_key, chunk_id)  # graftlint: disable=GL101 -- chunk-id counter stream off the dedicated fleet base key
-        scores = self._score_fn(params, batch_stats, rows, labels, key)
+        scores = self._program(params, batch_stats, rows, labels, key)
         # Device sync on the fleet thread — absorbing it off the trainer
         # thread is the fleet's whole purpose.
         scores_h = np.asarray(scores, np.float32)  # graftlint: disable=GL114 -- worker-side device sync: the fleet thread absorbs the fetch so the trainer never waits on scoring
@@ -321,7 +437,8 @@ class ScorerFleet:
         read freed memory — executable outputs are XLA-owned fresh
         buffers. Async dispatch, no host sync: the trainer thread pays
         one params-sized device copy every ``snapshot_every`` steps."""
-        snap_params, snap_stats = self._copy((params, batch_stats))
+        snap_params, snap_stats = self._program.snapshot(
+            params, batch_stats)
         self._snap = (snap_params, snap_stats, int(step))
         with self._lock:
             self._snapshots += 1

@@ -1,0 +1,725 @@
+"""Scoring as a service: the multi-tenant front over the scoring
+program, with the device backend's placement and pacing.
+
+``ScorerService`` is what ``refresh_mode="async"`` builds when
+``scorer_backend="device"`` or ``scorer_tenants > 1``; with the default
+``scorer_backend="host"`` and one tenant the PR-8
+:class:`~mercury_tpu.sampling.scorer_fleet.ScorerFleet` runs unchanged.
+The service keeps the fleet's entire external contract — the
+``(slots, scores, snapshot_step)`` :class:`ScoreChunk` protocol over
+bounded queues, ``snapshot()/drain()/score_once()/note_applied()/
+restart_workers()/close()`` — so the trainer's chunk apply
+(``apply_async_chunk`` + staleness weighting) is reused verbatim, and
+layers on top:
+
+- **Placement** (:class:`~mercury_tpu.sampling.scorer_fleet.
+  ScoringProgram`): ``backend="device"`` compiles the scoring forward
+  onto the dedicated scorer slice (``parallel/mesh.
+  reserve_scorer_slice`` — spare devices when the deployment left any,
+  else the CPU two-program degradation on the training mesh's own
+  devices) and pushes params to the slice by snapshot RPC.
+- **Pacing**: the device backend is *snapshot-paced* — each params RPC
+  opens a scoring epoch of at most a queue's worth of chunks per
+  tenant, so the dispatch duty cycle is bounded by ``snapshot_every``
+  (the device backend's analogue of ``scorer_throttle_s``, which is
+  meaningless there and validated to zero). The host backend under the
+  service keeps the fleet's continuous loop + throttle.
+- **Tenancy**: ``scorer_tenants`` independent consumers, each with its
+  own bounded ready queue, round-robin cursor, augmentation-key stream,
+  and snapshot reference. Chunk scheduling is smooth weighted
+  round-robin over ``scorer_tenant_weights`` with per-tenant queue
+  backpressure: a tenant whose queue is full (consumer stopped
+  draining) simply stops being scheduled — it cannot stall the service
+  or starve the other tenants. Tenant 0 feeds this trainer's score
+  table; the rest are drained and discarded after accounting.
+- **SLOs**: :meth:`ScorerService.slo_status` reports staleness
+  (``slo_score_staleness_max``) and queue-depth high-water
+  (``scorer_queue_highwater``) breaches; the trainer registers it with
+  ``HostSupervisor.register_slo`` so a breach walks the degradation
+  ladder (async → sync → frozen → uniform) exactly as a scorer death
+  does.
+- **Chaos**: the fleet's ``scorer_die``/``scorer_nan`` hooks fire at
+  the same site (``_score_chunk``); the service adds ``scorer_wedge``
+  (faults.py), which freezes one tenant's scheduling so the staleness
+  SLO path is exercisable end-to-end.
+
+Multi-process: the host backend stays single-controller (per-process
+chunk streams with no consistency protocol — loud error). The device
+backend's process-group mode runs ONE tenant and ONE worker per process
+in deterministic *lockstep*: chunk ``q`` is scored from snapshot ``q``
+and delivered only when snapshot ``q+1`` is installed, so every process
+applies identical chunks at identical ages and the per-process score
+tables cannot diverge. The lockstep barrier blocks the trainer thread
+at most once per ``snapshot_every`` steps (waiting out a straggling
+scorer), which is the price of determinism; all other combinations stay
+rejected with a loud error (:func:`validate_scorer_composition`).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.faults import InjectedFault
+from mercury_tpu.obs.trace import NULL_TRACER
+from mercury_tpu.sampling.scorer_fleet import ScoreChunk, ScoringProgram
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.sampling.scorer_service")
+
+#: Per-tenant augmentation-key stride: tenant ``i`` folds chunk ids
+#: ``i*_TENANT_KEY_STRIDE + seq`` into the fleet's base key, so tenant
+#: streams never collide and tenant 0's stream is IDENTICAL to the
+#: single-tenant fleet's (the bit-identity anchor).
+_TENANT_KEY_STRIDE = 0x100000
+
+#: Ceiling on scorer_tenants — per-tenant metric keys are registered
+#: explicitly (obs/registry.py) for t0..t3.
+MAX_TENANTS = 4
+
+
+def _parse_tenant_weights(config: TrainConfig) -> List[float]:
+    """Parse ``scorer_tenant_weights`` ("" = equal weights); raises
+    ``ValueError`` on length/positivity violations."""
+    n = int(config.scorer_tenants)
+    raw = (config.scorer_tenant_weights or "").strip()
+    if not raw:
+        return [1.0] * n
+    try:
+        weights = [float(w) for w in raw.split(",")]
+    except ValueError:
+        raise ValueError(
+            f"scorer_tenant_weights must be comma-separated numbers, got "
+            f"{config.scorer_tenant_weights!r}") from None
+    if len(weights) != n:
+        raise ValueError(
+            f"scorer_tenant_weights has {len(weights)} entries for "
+            f"scorer_tenants={n}")
+    if any(w <= 0 for w in weights):
+        raise ValueError(
+            f"scorer_tenant_weights entries must be > 0, got "
+            f"{config.scorer_tenant_weights!r}")
+    return weights
+
+
+def validate_scorer_composition(config: TrainConfig,
+                                process_count: int) -> None:
+    """Reject unsupported async-scorer compositions with loud, specific
+    errors (called from Trainer.__init__ before any thread spawns).
+
+    The PR-12 blanket multi-process rejection is lifted to the narrower
+    real constraint: the HOST backend's chunk stream is per-process with
+    no consistency protocol (still rejected), while the DEVICE backend
+    supports multi-process in deterministic lockstep — one tenant, one
+    worker per process."""
+    backend = config.scorer_backend
+    if backend not in ("host", "device"):
+        raise ValueError(
+            f"scorer_backend must be 'host' or 'device', got {backend!r}")
+    tenants = int(config.scorer_tenants)
+    if not 1 <= tenants <= MAX_TENANTS:
+        raise ValueError(
+            f"scorer_tenants must be in 1..{MAX_TENANTS} (per-tenant "
+            f"metric keys are registered for t0..t{MAX_TENANTS - 1}), "
+            f"got {tenants}")
+    _parse_tenant_weights(config)
+    if backend == "device" and float(config.scorer_throttle_s) != 0.0:
+        raise ValueError(
+            "scorer_throttle_s is a host-backend duty-cycle knob; the "
+            "device backend is snapshot-paced (each params RPC opens one "
+            "bounded scoring epoch, so snapshot_every bounds the duty "
+            "cycle) — set scorer_throttle_s=0, got "
+            f"{config.scorer_throttle_s}")
+    if process_count > 1:
+        if backend == "host":
+            raise ValueError(
+                "refresh_mode='async' with scorer_backend='host' is "
+                "single-controller only: the scorer fleet's params "
+                "snapshot and its (slots, scores) chunk stream are "
+                "per-process, with no cross-process protocol to keep "
+                "every host's score table consistent — "
+                "scorer_backend='device' runs the per-process scorer "
+                "program in deterministic lockstep and supports "
+                "multi-process")
+        if tenants > 1 or int(config.scorer_workers) > 1:
+            raise ValueError(
+                "multi-process scorer_backend='device' runs in "
+                "deterministic lockstep (chunk q scores from snapshot q, "
+                "delivers at snapshot q+1, on every process) and "
+                "supports exactly one tenant and one worker; got "
+                f"scorer_tenants={tenants}, "
+                f"scorer_workers={config.scorer_workers}")
+
+
+class _Tenant:
+    """One scoring consumer: bounded ready queue, round-robin cursor,
+    augmentation-key stream, snapshot reference, scheduler credit, and
+    SLO accounting. All mutable fields are guarded by the owning
+    service's lock except the queue (its own lock) and ``snap`` (a
+    single-writer published tuple, grabbed once per read)."""
+
+    def __init__(self, idx: int, weight: float, queue_max: int) -> None:
+        self.idx = idx
+        self.name = f"t{idx}"
+        self.weight = float(weight)
+        self.ready: "queue.Queue[ScoreChunk]" = queue.Queue(
+            maxsize=queue_max)
+        # (params, batch_stats, step) — replaced wholesale by snapshot();
+        # readers grab the tuple once, so torn reads are impossible.
+        self.snap: Optional[tuple] = None
+        self.cursor = 0            # round-robin chunk start
+        self.seq = 0               # augmentation-key counter
+        self.credit = 0.0          # smooth-WRR scheduler credit
+        self.inflight = 0          # queue slots reserved by scoring workers
+        self.scored_in_epoch = 0   # device pacing: chunks this snapshot epoch
+        self.wedged = False        # scorer_wedge chaos latch
+        self.chunks_scored = 0
+        self.rows_scored = 0
+        self.tick_rows = 0         # interval-delta marker for stats()
+        self.delivered = 0         # chunks handed to the consumer (drain)
+        self.discarded = 0         # non-primary tenants: drained-and-dropped
+        self.last_delivered_step: Optional[int] = None
+        self.staleness = 0         # steps since last delivered snapshot
+        self.slo_latched = False   # rising-edge breach latch
+        self.slo_breaches = 0
+
+
+class ScorerService:
+    """Multi-tenant scorer front (see module docstring). Construction
+    mirrors :class:`ScorerFleet` plus ``train_mesh`` (the device
+    backend reserves its slice relative to it)."""
+
+    def __init__(
+        self,
+        x_train: np.ndarray,
+        y_train: np.ndarray,
+        shard_indices: np.ndarray,
+        model,
+        mean: np.ndarray,
+        std: np.ndarray,
+        config: TrainConfig,
+        tracer=None,
+        faults=None,
+        train_mesh=None,
+    ) -> None:
+        validate_scorer_composition(config, jax.process_count())
+        self._x = np.asarray(x_train)
+        self._y = np.asarray(y_train)
+        self._shard_indices = np.asarray(shard_indices)
+        self._W, self._L = self._shard_indices.shape
+        self._R = int(config.refresh_size)
+        self._workers = int(config.scorer_workers)
+        self._throttle = float(config.scorer_throttle_s)
+        self._backend = config.scorer_backend
+        self._config = config
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        self._faults = faults
+
+        # Same sentinel stream as the fleet: tenant 0's chunk keys are
+        # fold_in(base, seq) — identical to the single-tenant fleet's.
+        self._base_key = jax.random.fold_in(  # graftlint: disable=GL101 -- deliberate sentinel stream 0x5C0 shared with the fleet so tenant 0's augmentation stream is bit-identical to the single-tenant fleet's
+            jax.random.key(config.seed), 0x5C0)
+        self._program = ScoringProgram(
+            model, mean, std, config, self._W,
+            backend=self._backend, train_mesh=train_mesh)
+
+        queue_max = max(2 * self._workers, 2)
+        # Device pacing: chunks each tenant may score per snapshot epoch
+        # — a queue's worth, so a full epoch exactly refills a drained
+        # queue and the duty cycle is bounded by snapshot_every.
+        self._epoch_cap = queue_max
+        weights = _parse_tenant_weights(config)
+        self._tenants = [
+            _Tenant(i, weights[i], queue_max)
+            for i in range(int(config.scorer_tenants))
+        ]
+
+        # Deterministic multi-process mode (device backend only; the
+        # composition validator pinned tenants == workers == 1).
+        self._lockstep = (self._backend == "device"
+                          and jax.process_count() > 1)
+        self._ls_req = threading.Event()    # trainer -> worker: score one
+        self._ls_done = threading.Event()   # worker -> trainer: chunk ready
+        self._ls_chunk: Optional[ScoreChunk] = None
+        self._ls_inflight = False
+
+        self._lock = threading.Lock()
+        # Work-available signal: set by snapshot() (a new epoch opens
+        # scoring budget) and drain_for_step() (freed queue slots), so
+        # idle workers park on a wait instead of polling — on a shared
+        # single-core host a 5 ms poll loop is measurable step-time
+        # interference for zero scoring done.
+        self._work = threading.Event()
+        self._chunks_scored = 0
+        self._rows_scored = 0
+        self._applied_chunks = 0
+        self._snapshots = 0
+        self._last_step = 0
+        self._ages: List[float] = []
+        self._tick_rows = 0
+        self._tick_t = time.perf_counter()
+
+        self._exc: Optional[BaseException] = None
+        self._closed = False
+        self._generation = 0
+        self._restarts = 0
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._spawn_workers()
+
+    # ----------------------------------------------------------- scheduling
+    def _eligible_locked(self, t: _Tenant) -> bool:
+        if t.wedged or t.snap is None:
+            return False
+        if t.ready.qsize() + t.inflight >= t.ready.maxsize:
+            return False  # consumer backpressure: full queue, skip tenant
+        if (self._backend == "device"
+                and t.scored_in_epoch >= self._epoch_cap):
+            return False  # snapshot pacing: epoch budget spent
+        return True
+
+    def _next_tenant(self) -> Optional[_Tenant]:
+        """Smooth weighted round-robin over eligible tenants, with a
+        queue-slot reservation so racing workers never overfill a
+        tenant's bounded queue (the put after scoring cannot block)."""
+        with self._lock:
+            eligible = [t for t in self._tenants if self._eligible_locked(t)]
+            if not eligible:
+                return None
+            for t in eligible:
+                t.credit += t.weight
+            pick = max(eligible, key=lambda t: t.credit)
+            pick.credit -= sum(t.weight for t in eligible)
+            pick.inflight += 1
+            if self._backend == "device":
+                pick.scored_in_epoch += 1
+            return pick
+
+    # -------------------------------------------------------------- scoring
+    def _score_chunk(self, t: _Tenant) -> Optional[ScoreChunk]:
+        """Score tenant ``t``'s next round-robin window on the calling
+        thread — the same hook sites and key discipline as
+        ``ScorerFleet._next_chunk``."""
+        snap = t.snap
+        if snap is None:
+            return None
+        faults = self._faults
+        if faults is not None and faults.fire("scorer_die") is not None:
+            raise InjectedFault("scorer_die: injected scorer death")
+        params, batch_stats, snap_step = snap
+        with self._lock:
+            start = t.cursor
+            t.cursor = (start + self._R) % self._L
+            seq = t.seq
+            t.seq += 1
+        slots = (start + np.arange(self._R)) % self._L        # [R]
+        gidx = self._shard_indices[:, slots]                  # [W, R]
+        rows = self._x[gidx]
+        labels = self._y[gidx]
+        key = jax.random.fold_in(  # graftlint: disable=GL101 -- per-tenant chunk-id counter stream off the dedicated fleet base key
+            self._base_key, t.idx * _TENANT_KEY_STRIDE + seq)
+        scores = self._program(params, batch_stats, rows, labels, key)
+        # Device sync on the service thread — absorbing the fetch off the
+        # trainer thread is the service's whole purpose.
+        scores_h = np.asarray(scores, np.float32)  # graftlint: disable=GL114 -- worker-side device sync: the service thread absorbs the fetch so the trainer never waits on scoring
+        if faults is not None and faults.fire("scorer_nan") is not None:
+            scores_h = np.full_like(scores_h, np.nan)
+        with self._lock:
+            t.chunks_scored += 1
+            t.rows_scored += self._W * self._R
+            self._chunks_scored += 1
+            self._rows_scored += self._W * self._R
+        return ScoreChunk(
+            slots=np.broadcast_to(
+                slots.astype(np.int32), (self._W, self._R)).copy(),
+            scores=scores_h,
+            step=int(snap_step),
+        )
+
+    def score_once(self, tenant: int = 0) -> ScoreChunk:
+        """Synchronously score one chunk for ``tenant`` on the calling
+        thread — deterministic path for tests, the sync-refresh ladder
+        level, and the recovery probe (no queues, no threads)."""
+        chunk = self._score_chunk(self._tenants[tenant])
+        if chunk is None:
+            raise RuntimeError(
+                "scorer service has no param snapshot yet — call "
+                "snapshot() before score_once()")
+        return chunk
+
+    # --------------------------------------------------------- worker loops
+    def _spawn_workers(self) -> None:
+        """(Re)spawn the worker set for the current generation; ``-rN``
+        name suffixes after a restart, like the fleet, so the Layer C
+        census can tell a supervisor respawn from a leak."""
+        gen = self._generation
+        suffix = f"-r{gen}" if gen else ""
+        self._stop = threading.Event()
+        stop = self._stop
+        self._threads = [
+            threading.Thread(target=self._run, args=(i, stop), daemon=True,
+                             name=f"mercury-scorer-svc-{i}{suffix}")
+            for i in range(self._workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self, idx: int, stop: threading.Event) -> None:
+        self._tracer.register_thread(f"scorer-svc{idx}")
+        try:
+            while not (self._closed or stop.is_set()):
+                if self._lockstep:
+                    self._lockstep_round(stop)
+                    continue
+                faults = self._faults
+                if faults is not None:
+                    args = faults.fire("scorer_wedge")
+                    if args is not None:
+                        wedge_idx = int(args.get("tenant", 0))
+                        with self._lock:
+                            self._tenants[wedge_idx].wedged = True
+                        _log.warning(
+                            "scorer_wedge injected: tenant t%d frozen "
+                            "(staleness SLO takes it from here)",
+                            wedge_idx)
+                t = self._next_tenant()
+                if t is None:
+                    # Nothing eligible: park until a producer signals
+                    # (clear-then-wait — a signal racing the clear only
+                    # costs one bounded timeout, not a lost wakeup).
+                    self._work.clear()
+                    self._work.wait(timeout=0.05)
+                    continue
+                try:
+                    with self._tracer.span("fleet/chunk", cat="scorer",
+                                           tenant=t.idx):
+                        chunk = self._score_chunk(t)
+                except BaseException:
+                    with self._lock:
+                        t.inflight -= 1
+                    raise
+                with self._lock:
+                    t.inflight -= 1
+                if chunk is None:
+                    continue
+                # The scheduler reserved this queue slot (inflight), so
+                # the put cannot block: only the consumer takes items.
+                t.ready.put_nowait(chunk)
+                with self._lock:
+                    t.last_delivered_step = chunk.step
+                # Duty-cycle throttle (host backend only — the device
+                # backend is snapshot-paced), in short slices so close()
+                # never waits out a long sleep.
+                deadline = time.perf_counter() + self._throttle
+                while not (self._closed or stop.is_set()):
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    time.sleep(min(left, 0.05))
+        except BaseException as exc:  # surface on the next drain()
+            self._exc = exc
+            _log.warning("scorer service worker %d died: %s: %s",
+                         idx, type(exc).__name__, exc)
+
+    def _lockstep_round(self, stop: threading.Event) -> None:
+        """One lockstep iteration: wait for the trainer's score request
+        (armed by :meth:`snapshot`), score chunk ``q`` from snapshot
+        ``q``, publish it for delivery at snapshot ``q+1``."""
+        if not self._ls_req.wait(timeout=0.05):
+            return
+        if self._closed or stop.is_set():
+            return
+        self._ls_req.clear()
+        with self._tracer.span("fleet/chunk", cat="scorer", tenant=0):
+            self._ls_chunk = self._score_chunk(self._tenants[0])
+        self._ls_done.set()
+
+    # ----------------------------------------------------------- lifecycle
+    def snapshot(self, params, batch_stats, step: int) -> None:
+        """Install a fresh param snapshot for every tenant.
+
+        One program-side copy (+ device-backend snapshot RPC onto the
+        scorer slice); each tenant then holds its own reference with the
+        step it was taken at. Opens a new device-pacing epoch. In
+        lockstep mode this is also the delivery barrier: the previous
+        epoch's chunk is collected (blocking out a straggling scorer —
+        the determinism price) and enqueued BEFORE the new snapshot
+        arms the next score request."""
+        snap = self._program.snapshot(params, batch_stats)
+        if self._lockstep:
+            self._lockstep_deliver()
+        with self._lock:
+            for t in self._tenants:
+                t.snap = (snap[0], snap[1], int(step))
+                t.scored_in_epoch = 0
+            self._snapshots += 1
+            self._last_step = int(step)
+        self._work.set()
+        if self._lockstep and self._exc is None and not self._closed:
+            self._ls_done.clear()
+            self._ls_req.set()
+            self._ls_inflight = True
+
+    def _lockstep_deliver(self) -> None:
+        if not self._ls_inflight:
+            return
+        ok = self._ls_done.wait(timeout=60.0)
+        self._ls_inflight = False
+        if not ok:
+            if self._exc is None:
+                _log.warning(
+                    "lockstep scorer missed the snapshot barrier (60s) — "
+                    "chunk skipped; drain() surfaces any worker death")
+            return
+        self._ls_done.clear()
+        chunk, self._ls_chunk = self._ls_chunk, None  # graftlint: disable=GL120 -- strict handoff: the worker writes _ls_chunk then _ls_done.set(); this read runs only after _ls_done.wait() succeeded, so the event is the happens-before edge and exactly one thread owns the slot at a time
+        if chunk is None:
+            return
+        t0 = self._tenants[0]
+        try:
+            t0.ready.put_nowait(chunk)
+        except queue.Full:
+            # Consumer stopped draining: drop deterministically (every
+            # process sees the same full queue — drains are in the same
+            # fit-loop order everywhere).
+            return
+        with self._lock:
+            t0.last_delivered_step = chunk.step
+
+    def drain_for_step(self, step: int) -> List[ScoreChunk]:
+        """Tenant 0's ready chunks (the trainer applies them); other
+        tenants' queues are drained into their accounting and discarded
+        — they model external consumers. Also advances every tenant's
+        staleness clock against ``step`` (the SLO input). Raises if a
+        worker died, like the fleet's drain."""
+        if self._exc is not None:
+            raise RuntimeError(
+                "scorer service worker died") from self._exc
+        out: List[ScoreChunk] = []
+        freed = False
+        with self._lock:
+            self._last_step = int(step)
+        for t in self._tenants:
+            while True:
+                try:
+                    chunk = t.ready.get_nowait()
+                except queue.Empty:
+                    break
+                freed = True
+                with self._lock:
+                    t.delivered += 1
+                    if t.idx != 0:
+                        t.discarded += 1
+                if t.idx == 0:
+                    out.append(chunk)
+            with self._lock:
+                if t.last_delivered_step is not None:
+                    t.staleness = max(int(step) - t.last_delivered_step, 0)
+        # Freed queue slots re-arm HOST-backend workers (continuous
+        # duty cycle). The device backend deliberately does NOT wake on
+        # drain: its epoch budget means freed slots mid-epoch are rare,
+        # and waking it here would smear the scoring burst across the
+        # training steps instead of keeping it snapshot-adjacent (where
+        # params are freshest and, on a shared-core host, where it
+        # interferes least with the step program).
+        if freed and self._backend == "host":
+            self._work.set()
+        return out
+
+    def drain(self) -> List[ScoreChunk]:
+        """Fleet-compatible drain (uses the last known step for the
+        staleness clock; the trainer calls :meth:`drain_for_step`)."""
+        return self.drain_for_step(self._last_step)
+
+    def slo_status(self, step: int) -> Optional[str]:
+        """Current SLO breach description, or None when healthy.
+
+        Checked by the supervisor each tick (``register_slo``): tenant
+        staleness above ``slo_score_staleness_max`` or queue depth at or
+        above ``scorer_queue_highwater``. Per-tenant breach counters
+        latch on the rising edge (``scorer/slo_breaches/t{i}``)."""
+        stale_max = int(self._config.slo_score_staleness_max)
+        highwater = int(self._config.scorer_queue_highwater)
+        breaches: List[str] = []
+        with self._lock:
+            for t in self._tenants:
+                reasons = []
+                if stale_max > 0 and t.last_delivered_step is not None:
+                    staleness = max(int(step) - t.last_delivered_step, 0)
+                    t.staleness = staleness
+                    if staleness > stale_max:
+                        reasons.append(
+                            f"staleness {staleness} > {stale_max}")
+                if highwater > 0 and t.ready.qsize() >= highwater:
+                    reasons.append(
+                        f"queue depth {t.ready.qsize()} >= {highwater}")
+                if reasons:
+                    if not t.slo_latched:
+                        t.slo_latched = True
+                        t.slo_breaches += 1
+                    breaches.append(f"{t.name}: " + ", ".join(reasons))
+                else:
+                    t.slo_latched = False
+        return "; ".join(breaches) if breaches else None
+
+    def note_applied(self, age: int) -> None:
+        """Record an applied chunk's age for the staleness telemetry
+        (same contract as the fleet)."""
+        with self._lock:
+            self._applied_chunks += 1
+            self._ages.append(float(max(age, 0)))
+
+    def reset(self) -> None:
+        """Discard queued chunks (checkpoint restore). The caller
+        re-snapshots after."""
+        for t in self._tenants:
+            while True:
+                try:
+                    t.ready.get_nowait()
+                except queue.Empty:
+                    break
+        with self._lock:
+            self._ages = []
+
+    def alive(self) -> bool:
+        """Supervisor liveness probe — single-writer published flags
+        only, no lock (the fleet's idiom)."""
+        if self._closed or self._exc is not None:
+            return False
+        return all(t.is_alive() for t in self._threads)
+
+    def restart_workers(self, timeout: float = 5.0) -> int:
+        """Supervisor restart: retire the worker generation, clear the
+        failure latch and queue-slot reservations, respawn under
+        ``-rN``-suffixed names. Queued chunks survive."""
+        if self._closed:
+            raise RuntimeError("restart_workers() on a closed "
+                               "ScorerService")
+        self._stop.set()
+        self._work.set()  # release idle workers so the join is prompt
+        deadline = time.perf_counter() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.perf_counter()))
+        wedged = [t.name for t in self._threads if t.is_alive()]
+        if wedged:
+            _log.warning(
+                "scorer service restart: previous-generation threads "
+                "still alive %.0fs after stop — abandoning wedged "
+                "(daemon): %s", timeout, ", ".join(wedged))
+        self._exc = None  # graftlint: disable=GL120 -- prior generation is stopped+joined above; an abandoned wedged worker exits via its generation's stop event without writing the latch
+        self._ls_req.clear()  # graftlint: disable=GL120 -- prior generation is stopped+joined above; the req/done pair is a two-phase handshake (trainer sets req only with done cleared, worker clears req before scoring) and Event mutations are internally locked
+        self._ls_done.clear()
+        self._ls_inflight = False
+        self._ls_chunk = None
+        self._generation += 1
+        with self._lock:
+            self._restarts += 1
+            for t in self._tenants:
+                t.inflight = 0  # reservations died with their workers
+        self._spawn_workers()
+        _log.warning("scorer service restarted: generation %d "
+                     "(%d workers)", self._generation, self._workers)
+        return self._generation
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Idempotent shutdown with a bounded join (fleet contract)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._ls_req.set()  # release a lockstep worker parked on wait()
+        self._work.set()    # ...and an idle worker parked on the signal
+        deadline = time.perf_counter() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.perf_counter()))
+        wedged = [t.name for t in self._threads if t.is_alive()]
+        if wedged:
+            _log.warning(
+                "scorer service threads still alive %.0fs after close() "
+                "— abandoning wedged (daemon): %s",
+                timeout, ", ".join(wedged))
+
+    # ----------------------------------------------------------- telemetry
+    def stats(self) -> Dict[str, float]:
+        """Interval-delta metrics for the log gate: the fleet's sampler
+        keys (so dashboards carry over) plus the service aggregates and
+        per-tenant streams. Host floats only — no device sync. Keys are
+        registered in obs/registry.py."""
+        now = time.perf_counter()
+        out: Dict[str, float] = {}
+        with self._lock:
+            rows = self._rows_scored - self._tick_rows
+            self._tick_rows = self._rows_scored
+            dt = max(now - self._tick_t, 1e-9)
+            self._tick_t = now
+            ages = self._ages
+            self._ages = []
+            depth_total = 0
+            for t in self._tenants:
+                t_rows = t.rows_scored - t.tick_rows
+                t.tick_rows = t.rows_scored
+                depth = t.ready.qsize()
+                depth_total += depth
+                out[f"scorer/throughput/{t.name}"] = t_rows / dt
+                out[f"scorer/queue_depth/{t.name}"] = float(depth)
+                out[f"scorer/staleness/{t.name}"] = float(t.staleness)
+                out[f"scorer/slo_breaches/{t.name}"] = float(
+                    t.slo_breaches)
+            staleness_max = max(t.staleness for t in self._tenants)
+            breaches = sum(t.slo_breaches for t in self._tenants)
+            t0_depth = self._tenants[0].ready.qsize()
+        out["scorer/throughput"] = rows / dt
+        out["scorer/queue_depth"] = float(depth_total)
+        out["scorer/staleness"] = float(staleness_max)
+        out["scorer/slo_breaches"] = float(breaches)
+        out["sampler/refresh_lag_chunks"] = float(t0_depth)
+        out["threads/queue_depth/scorer"] = float(depth_total)
+        out["sampler/score_staleness_mean"] = (
+            (sum(ages) / len(ages)) if ages else 0.0)
+        out["sampler/score_staleness_max"] = max(ages) if ages else 0.0
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """Cumulative counters for flight records — the fleet's shape
+        plus backend/tenancy detail."""
+        closed = self._closed
+        alive = sum(1 for t in self._threads if t.is_alive())
+        with self._lock:
+            tenants = [
+                {
+                    "name": t.name,
+                    "weight": t.weight,
+                    "chunks_scored": t.chunks_scored,
+                    "delivered": t.delivered,
+                    "discarded": t.discarded,
+                    "queue_depth": t.ready.qsize(),
+                    "staleness": t.staleness,
+                    "slo_breaches": t.slo_breaches,
+                    "wedged": t.wedged,
+                }
+                for t in self._tenants
+            ]
+            snap0 = self._tenants[0].snap
+            return {
+                "workers": self._workers,
+                "workers_alive": alive,
+                "generation": self._generation,
+                "restarts": self._restarts,
+                "chunk_shape": [self._W, self._R],
+                "chunks_scored": self._chunks_scored,
+                "rows_scored": self._rows_scored,
+                "chunks_applied": self._applied_chunks,
+                "snapshots": self._snapshots,
+                "snapshot_step": None if snap0 is None else int(snap0[2]),
+                "queue_depth": sum(t["queue_depth"] for t in tenants),
+                "closed": closed,
+                "lockstep": self._lockstep,
+                "program": self._program.describe(),
+                "tenants": tenants,
+            }

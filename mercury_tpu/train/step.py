@@ -325,6 +325,30 @@ def make_train_step(
                 "scorer_throttle_s must be >= 0, got "
                 f"{config.scorer_throttle_s}"
             )
+    if config.scorer_backend not in ("host", "device"):
+        raise ValueError(
+            "scorer_backend must be 'host' or 'device', got "
+            f"{config.scorer_backend!r}"
+        )
+    if not async_refresh:
+        # Backend/tenancy knobs only mean something under the async
+        # scorer — a silently-ignored scorer_backend='device' on a sync
+        # run would read as the device scorer being in play.
+        if config.scorer_backend != "host":
+            raise ValueError(
+                "scorer_backend='device' requires refresh_mode='async' "
+                "with sampler='scoretable' (the device scorer program "
+                "feeds the async chunk queue; the sync path scores "
+                "in-graph) — got refresh_mode="
+                f"{config.refresh_mode!r}, sampler={config.sampler!r}"
+            )
+        if int(config.scorer_tenants) != 1:
+            raise ValueError(
+                "scorer_tenants requires refresh_mode='async' with "
+                "sampler='scoretable' (tenancy is a property of the "
+                f"scorer service) — got scorer_tenants="
+                f"{config.scorer_tenants}"
+            )
 
     if config.importance_score not in ("loss", "grad_norm"):
         raise ValueError(

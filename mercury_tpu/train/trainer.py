@@ -760,20 +760,22 @@ class Trainer:
         if (config.use_importance_sampling
                 and config.sampler == "scoretable"
                 and config.refresh_mode == "async"):
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "refresh_mode='async' is single-controller only: the "
-                    "scorer fleet's params snapshot and its (slots, "
-                    "scores) chunk stream are per-process, with no "
-                    "cross-process protocol to keep every host's score "
-                    "table consistent"
-                )
-            from mercury_tpu.sampling.scorer_fleet import ScorerFleet
+            from mercury_tpu.sampling.scorer_service import (
+                ScorerService,
+                validate_scorer_composition,
+            )
 
-            # The fleet's scoring forwards run OUTSIDE shard_map, where
-            # the mesh data axis doesn't exist — build a local-BN scorer
-            # clone (params are shared; flax modules are layout, not
-            # weights). scoring_dtype applies, as it would in-graph.
+            # Reject unsupported backend/tenancy/process compositions
+            # with loud, specific errors BEFORE any thread spawns. The
+            # old blanket multi-process rejection lives here now, scoped
+            # to the host backend (the device backend's lockstep mode
+            # supports multi-process; see sampling/scorer_service.py).
+            validate_scorer_composition(config, jax.process_count())
+
+            # The scoring forwards run OUTSIDE shard_map, where the mesh
+            # data axis doesn't exist — build a local-BN scorer clone
+            # (params are shared; flax modules are layout, not weights).
+            # scoring_dtype applies, as it would in-graph.
             fleet_model = create_model(
                 config.model,
                 num_classes=self.dataset.num_classes,
@@ -782,7 +784,7 @@ class Trainer:
                 bn_axis_name=None,
                 **model_kw,
             )
-            self._scorer_fleet = ScorerFleet(
+            scorer_args = (
                 np.asarray(self.dataset.x_train),
                 np.asarray(self.dataset.y_train),
                 np.asarray(self.dataset.shard_indices),
@@ -790,9 +792,30 @@ class Trainer:
                 self.dataset.mean,
                 self.dataset.std,
                 config,
-                tracer=self.tracer,
-                faults=self._faults,
             )
+            # Plain host-backend single-tenant runs keep the PR-8 fleet
+            # unchanged; the device backend, any multi-tenant run, and
+            # any armed scoring SLO go through the ScorerService front
+            # (same external contract — the fleet has no slo_status).
+            use_service = (config.scorer_backend == "device"
+                           or config.scorer_tenants > 1
+                           or config.slo_score_staleness_max > 0
+                           or config.scorer_queue_highwater > 0)
+            if use_service:
+                self._scorer_fleet = ScorerService(
+                    *scorer_args,
+                    tracer=self.tracer,
+                    faults=self._faults,
+                    train_mesh=self.mesh,
+                )
+            else:
+                from mercury_tpu.sampling.scorer_fleet import ScorerFleet
+
+                self._scorer_fleet = ScorerFleet(
+                    *scorer_args,
+                    tracer=self.tracer,
+                    faults=self._faults,
+                )
             self._apply_refresh = self._make_refresh_apply()
             self._scorer_fleet.snapshot(
                 self.state.params, self.state.batch_stats,
@@ -804,7 +827,7 @@ class Trainer:
                 # trainer thread, frozen, or flattened to uniform —
                 # training proceeds either way).
                 self.supervisor.register_unit(
-                    "scorer",
+                    "scorer_service" if use_service else "scorer",
                     alive=lambda: self._scorer_fleet.alive(),
                     restart=lambda: self._scorer_fleet.restart_workers(),
                     escalates=True,
@@ -813,6 +836,16 @@ class Trainer:
                     probe=self._probe_scoring,
                     revive=lambda: self._scorer_fleet.restart_workers(),
                 )
+                if use_service:
+                    # Backpressure + staleness SLOs enter the ladder:
+                    # a breach (wedged tenant, undrained queue) walks
+                    # async → sync → frozen → uniform exactly as a
+                    # scorer death does.
+                    self.supervisor.register_slo(
+                        "scorer_service",
+                        lambda: self._scorer_fleet.slo_status(
+                            self._host_step),
+                    )
 
         # Crash/preemption recovery: pick up the newest checkpoint, sampler
         # state included (bit-deterministic IS resume). The NEXT fit() then
@@ -1067,7 +1100,13 @@ class Trainer:
             # raise) — supervisor.tick() restarts the fleet or walks the
             # ladder; queued chunks survive the restart.
             return
-        chunks = fleet.drain()
+        if hasattr(fleet, "drain_for_step"):
+            # ScorerService: the step-aware drain also advances every
+            # tenant's staleness clock (the SLO input) and empties the
+            # non-primary tenants' queues into their accounting.
+            chunks = fleet.drain_for_step(step)
+        else:
+            chunks = fleet.drain()
         if chunks:
             with self.tracer.span("trainer/apply_refresh", cat="trainer",
                                   chunks=len(chunks)):

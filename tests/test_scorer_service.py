@@ -1,0 +1,416 @@
+"""Device-backed scorer service (``config.scorer_backend = "device"``,
+``config.scorer_tenants > 1``): rescoring runs as its own jit program on
+a reserved mesh slice behind a multi-tenant ``ScorerService`` front with
+per-tenant bounded queues, smooth weighted-fair drain, and backpressure +
+staleness SLOs wired into the ``HostSupervisor`` ladder.
+
+The load-bearing contracts pinned here:
+
+- a device-backend chunk is BIT-identical to the host fleet's chunk at
+  equal snapshot age (per-row vmap has no cross-row math, so placement
+  cannot change the numerics — the acceptance criterion for reusing
+  ``apply_async_chunk`` verbatim);
+- composition errors name the REAL constraint per backend (the host
+  fleet's per-process snapshot/chunk stream; the device backend's
+  snapshot pacing vs ``scorer_throttle_s``; lockstep's 1-tenant/1-worker
+  shape), and the narrowed multi-process gate ACCEPTS device lockstep;
+- a wedged tenant starves neither training nor the other tenant, and
+  with the staleness SLO armed it walks the ladder instead of hanging.
+"""
+
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mercury_tpu.config import TrainConfig
+from mercury_tpu.parallel.mesh import (
+    host_cpu_mesh,
+    make_scorer_mesh,
+    reserve_scorer_slice,
+)
+from mercury_tpu.runtime.supervisor import HostSupervisor
+from mercury_tpu.sampling.scorer_service import (
+    ScorerService,
+    validate_scorer_composition,
+)
+from mercury_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return host_cpu_mesh(4)
+
+
+def svc_cfg(**kw) -> TrainConfig:
+    base = dict(
+        model="smallcnn",
+        dataset="synthetic",
+        world_size=4,
+        batch_size=8,
+        presample_batches=2,
+        num_epochs=1,
+        steps_per_epoch=6,
+        eval_every=0,
+        log_every=0,
+        heartbeat_every=0,
+        checkpoint_every=0,
+        compute_dtype="float32",
+        seed=0,
+        sampler="scoretable",
+        refresh_size=8,
+        refresh_mode="async",
+        scorer_workers=1,
+        scorer_throttle_s=0.0,
+        snapshot_every=2,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+class TestComposition:
+    """Knob validation: every rejected combo names its real constraint,
+    and the narrowed multi-process gate admits exactly device lockstep."""
+
+    def test_device_rejects_throttle(self, mesh):
+        with pytest.raises(ValueError, match="snapshot-paced"):
+            Trainer(svc_cfg(scorer_backend="device",
+                            scorer_throttle_s=0.5), mesh=mesh)
+
+    @pytest.mark.parametrize("bad", [
+        dict(scorer_backend="gpu_farm"),
+        dict(scorer_tenants=0),
+        dict(scorer_tenants=5),
+        dict(scorer_tenants=2, scorer_tenant_weights="1.0"),
+        dict(scorer_tenants=2, scorer_tenant_weights="1.0,-1.0"),
+        dict(scorer_tenants=2, scorer_tenant_weights="1.0,abc"),
+    ])
+    def test_invalid_knobs_rejected(self, mesh, bad):
+        with pytest.raises(ValueError):
+            Trainer(svc_cfg(**bad), mesh=mesh)
+
+    def test_device_requires_async(self, mesh):
+        with pytest.raises(ValueError, match="refresh_mode='async'"):
+            Trainer(svc_cfg(refresh_mode="sync",
+                            scorer_backend="device"), mesh=mesh)
+
+    def test_tenants_require_async(self, mesh):
+        with pytest.raises(ValueError, match="scorer_tenants"):
+            Trainer(svc_cfg(refresh_mode="sync",
+                            scorer_tenants=2), mesh=mesh)
+
+    def test_multiprocess_host_still_names_fleet_constraint(self):
+        """PR 12's blanket rejection narrowed to the real constraint:
+        the HOST backend's per-process snapshot/chunk stream. The
+        message regex is shared with test_async_refresh.py's
+        trainer-level pin."""
+        with pytest.raises(ValueError, match="scorer fleet.*per-process"):
+            validate_scorer_composition(svc_cfg(), process_count=2)
+
+    def test_multiprocess_device_lockstep_accepted(self):
+        """The narrowed gate: device backend with 1 tenant / 1 worker
+        runs deterministic lockstep under multi-controller — accepted."""
+        validate_scorer_composition(
+            svc_cfg(scorer_backend="device"), process_count=2)
+
+    @pytest.mark.parametrize("bad,pat", [
+        (dict(scorer_tenants=2), "lockstep"),
+        (dict(scorer_workers=2), "lockstep"),
+    ])
+    def test_multiprocess_device_nonlockstep_rejected(self, bad, pat):
+        with pytest.raises(ValueError, match=pat):
+            validate_scorer_composition(
+                svc_cfg(scorer_backend="device", **bad), process_count=2)
+
+
+class TestScorerSlice:
+    """Mesh-slice reservation: spare devices when the train mesh leaves
+    any, graceful degradation to shared devices when it does not."""
+
+    def test_spares_reserved_when_available(self, mesh):
+        devs = reserve_scorer_slice(mesh)
+        train_ids = {d.id for d in mesh.devices.flat}
+        assert len(devs) == len(jax.devices()) - len(train_ids)
+        assert all(d.id not in train_ids for d in devs)
+
+    def test_full_mesh_degrades_to_shared_slice(self):
+        full = host_cpu_mesh(len(jax.devices()))
+        devs = reserve_scorer_slice(full)
+        assert {d.id for d in devs} == {d.id for d in full.devices.flat}
+
+    def test_scorer_mesh_axis_name(self, mesh):
+        m = make_scorer_mesh(mesh)
+        assert m.axis_names == ("scorer",)
+
+
+class TestDeviceBackend:
+    """The tentpole: scoring as its own jit program on the reserved
+    slice, numerically indistinguishable from the host fleet."""
+
+    def test_device_fit_and_stats(self, mesh):
+        t = Trainer(svc_cfg(scorer_backend="device"), mesh=mesh)
+        try:
+            assert isinstance(t._scorer_fleet, ScorerService)
+            out = t.fit(num_epochs=1)
+            assert np.isfinite(out["test/eval_loss"])
+            assert int(t.state.step) == 6
+            summ = t._scorer_fleet.summary()
+            assert summ["chunks_scored"] >= 1
+            assert summ["program"]["backend"] == "device"
+            assert summ["program"]["dedicated_slice"]  # 4 spares of 8
+            stats = t._scorer_fleet.stats()
+            assert {
+                "scorer/throughput", "scorer/queue_depth",
+                "scorer/staleness", "scorer/slo_breaches",
+                "scorer/throughput/t0", "scorer/queue_depth/t0",
+                "scorer/staleness/t0", "scorer/slo_breaches/t0",
+                "sampler/refresh_lag_chunks",
+                "sampler/score_staleness_mean",
+                "sampler/score_staleness_max",
+                "threads/queue_depth/scorer",
+            } <= set(stats)
+            assert all(np.isfinite(v) for v in stats.values())
+        finally:
+            t.close()
+
+    def test_device_chunk_bit_identical_to_host(self, mesh):
+        """Acceptance criterion: at equal snapshot age the device
+        backend's (slots, scores, step) chunk is bitwise equal to the
+        host fleet's — so the staleness-weighted apply path is reused
+        verbatim with zero numeric drift. Standalone instances with
+        quiesced workers: the cursor/key streams advance only through
+        the deterministic score_once path."""
+        from mercury_tpu.sampling.scorer_fleet import ScorerFleet
+
+        donor = Trainer(svc_cfg(), mesh=mesh)
+        try:
+            src = donor._scorer_fleet
+            parts = (src._x, src._y, src._shard_indices, src._model,
+                     src._mean, src._std)
+            fleet = ScorerFleet(*parts, svc_cfg())
+            svc = ScorerService(*parts, svc_cfg(scorer_backend="device"),
+                                train_mesh=mesh)
+            try:
+                for obj in (fleet, svc):
+                    obj._stop.set()
+                    for th in obj._threads:
+                        th.join(timeout=10.0)
+                p, bs = donor.state.params, donor.state.batch_stats
+                fleet.snapshot(p, bs, 3)
+                svc.snapshot(p, bs, 3)
+                for _ in range(2):  # cursor + key streams stay in step
+                    host_chunk = fleet.score_once()
+                    dev_chunk = svc.score_once()
+                    assert host_chunk.step == dev_chunk.step == 3
+                    np.testing.assert_array_equal(
+                        np.asarray(host_chunk.slots),
+                        np.asarray(dev_chunk.slots))
+                    np.testing.assert_array_equal(
+                        np.asarray(host_chunk.scores),
+                        np.asarray(dev_chunk.scores))
+            finally:
+                svc.close()
+                fleet.close()
+        finally:
+            donor.close()
+
+
+class TestTenants:
+    """Multi-tenant front: tenant 0 feeds the trainer's table, extra
+    tenants are drained and accounted; the weighted-fair scheduler keeps
+    every tenant's chunk share within 2x of its weight."""
+
+    def test_two_tenant_fit(self, mesh):
+        t = Trainer(svc_cfg(scorer_tenants=2,
+                            scorer_tenant_weights="2,1"), mesh=mesh)
+        try:
+            out = t.fit(num_epochs=1)
+            assert np.isfinite(out["test/eval_loss"])
+            tenants = {x["name"]: x
+                       for x in t._scorer_fleet.summary()["tenants"]}
+            assert tenants["t0"]["chunks_scored"] >= 1
+            assert tenants["t1"]["chunks_scored"] >= 1
+            assert tenants["t0"]["delivered"] >= 1
+            stats = t._scorer_fleet.stats()
+            assert "scorer/throughput/t1" in stats
+        finally:
+            t.close()
+
+    def test_weighted_fair_shares(self, mesh):
+        """Drain promptly so queue backpressure never gates eligibility:
+        the smooth-WRR shares must then track the 3:1 weights, and in
+        any case each tenant's share stays within 2x of its weight."""
+        t = Trainer(svc_cfg(scorer_tenants=2, scorer_workers=2,
+                            scorer_tenant_weights="3,1"), mesh=mesh)
+        try:
+            svc = t._scorer_fleet
+            svc.snapshot(t.state.params, t.state.batch_stats, 0)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                svc.drain_for_step(0)
+                counts = [x["chunks_scored"]
+                          for x in svc.summary()["tenants"]]
+                if sum(counts) >= 24:
+                    break
+                time.sleep(0.01)
+            total = sum(counts)
+            assert total >= 24, f"scored only {total} chunks in 60s"
+            shares = [c / total for c in counts]
+            for share, weight in zip(shares, (0.75, 0.25)):
+                assert share >= weight / 2.0, (shares, weight)
+        finally:
+            t.close()
+
+
+class TestBackpressure:
+    """Satellite 3: a wedged tenant queue must neither stall training
+    nor starve the healthy tenant, and with the staleness SLO armed it
+    walks the supervisor ladder instead of hanging."""
+
+    def test_wedged_tenant_does_not_stall_others(self, mesh):
+        t = Trainer(svc_cfg(scorer_tenants=2, steps_per_epoch=8,
+                            fault_spec="scorer_wedge@step=1,tenant=1"),
+                    mesh=mesh)
+        try:
+            out = t.fit(num_epochs=1)
+            assert np.isfinite(out["test/eval_loss"])
+            assert int(t.state.step) == 8  # training never stalled
+            tenants = {x["name"]: x
+                       for x in t._scorer_fleet.summary()["tenants"]}
+            # The healthy tenant kept scoring well past the wedge point.
+            assert tenants["t0"]["chunks_scored"] > \
+                tenants["t1"]["chunks_scored"]
+            assert tenants["t1"]["wedged"]
+        finally:
+            t.close()
+
+    def test_staleness_slo_walks_ladder(self, mesh):
+        t = Trainer(svc_cfg(scorer_tenants=2, steps_per_epoch=10,
+                            snapshot_every=1, supervise=True,
+                            supervisor_probe_every=1000,
+                            slo_score_staleness_max=2,
+                            fault_spec="scorer_wedge@step=1,tenant=1"),
+                    mesh=mesh)
+        try:
+            out = t.fit(num_epochs=1)
+            assert np.isfinite(out["test/eval_loss"])
+            assert int(t.state.step) == 10  # degraded, not deadlocked
+            assert t.supervisor.level() >= 1
+            stats = t.supervisor.stats()
+            assert stats["supervisor/slo_breaches"] >= 1
+            svc_stats = t._scorer_fleet.stats()
+            assert svc_stats["scorer/slo_breaches/t1"] >= 1
+        finally:
+            t.close()
+
+    def test_queue_highwater_slo(self, mesh):
+        """The queue-depth SLO breaches without any fault: park the
+        service undrained until the worker fills tenant 0's bounded
+        queue past the high-water mark."""
+        t = Trainer(svc_cfg(scorer_queue_highwater=1), mesh=mesh)
+        try:
+            svc = t._scorer_fleet
+            svc.snapshot(t.state.params, t.state.batch_stats, 0)
+            deadline = time.monotonic() + 60.0
+            status = None
+            while time.monotonic() < deadline and status is None:
+                status = svc.slo_status(0)
+                time.sleep(0.01)
+            assert status is not None and "queue depth" in status
+        finally:
+            t.close()
+
+
+class TestLockstep:
+    """Multi-controller device mode: chunk q is scored from snapshot q
+    and delivered only when snapshot q+1 installs — the pairing every
+    process computes identically, keeping per-process tables bit-exact
+    without a cross-host protocol."""
+
+    def test_lockstep_delivers_one_snapshot_behind(self, mesh,
+                                                   monkeypatch):
+        donor = Trainer(svc_cfg(), mesh=mesh)
+        try:
+            fleet = donor._scorer_fleet
+            monkeypatch.setattr(jax, "process_count", lambda: 2)
+            svc = ScorerService(
+                fleet._x, fleet._y, fleet._shard_indices, fleet._model,
+                fleet._mean, fleet._std,
+                svc_cfg(scorer_backend="device"), train_mesh=mesh)
+            try:
+                assert svc.summary()["lockstep"]
+                p, bs = donor.state.params, donor.state.batch_stats
+                svc.snapshot(p, bs, 0)   # arms scoring of chunk 0
+                time.sleep(0.3)
+                assert svc.drain_for_step(1) == []  # held until next snap
+                svc.snapshot(p, bs, 2)   # installs snap 1, releases chunk
+                chunks = svc.drain_for_step(2)
+                assert len(chunks) == 1
+                assert chunks[0].step == 0  # scored from snapshot 0
+            finally:
+                svc.close()
+        finally:
+            donor.close()
+
+
+class TestSupervisorSlo:
+    """HostSupervisor.register_slo unit semantics: rising-edge latch
+    (a persistent breach walks ONE level), clear + re-breach walks
+    another, and a still-breaching SLO pins the recovery probe."""
+
+    def _sup(self):
+        sup = HostSupervisor(probe_every=1, backoff_s=0.0)
+        sup.set_ladder(probe=lambda: None, revive=lambda: None)
+        return sup
+
+    def test_rising_edge_latch_and_rebreach(self):
+        sup = self._sup()
+        breach = {"status": None}
+        sup.register_slo("t", lambda: breach["status"])
+        try:
+            sup.tick(0)
+            assert sup.level() == 0
+            breach["status"] = "on fire"
+            sup.tick(1)
+            sup.tick(2)
+            sup.tick(3)
+            assert sup.level() == 1  # latched: no free-fall to uniform
+            breach["status"] = None
+            # Probe climbs back once the SLO clears (pinned before).
+            for s in range(4, 8):
+                sup.tick(s)
+            assert sup.level() == 0
+            breach["status"] = "on fire again"
+            sup.tick(8)
+            assert sup.level() == 1  # re-breach walks another level
+            assert sup.stats()["supervisor/slo_breaches"] == 2.0
+            assert "t" in sup.summary()["slos"][0]["name"]
+        finally:
+            sup.close()
+
+    def test_breaching_slo_pins_recovery(self):
+        sup = self._sup()
+        sup.register_slo("t", lambda: "still broken")
+        try:
+            for s in range(6):
+                sup.tick(s)
+            assert sup.level() == 1  # probe never climbed while breached
+        finally:
+            sup.close()
+
+    def test_raising_check_is_contained(self):
+        sup = self._sup()
+
+        def bad_check():
+            raise RuntimeError("checker bug")
+
+        sup.register_slo("t", bad_check)
+        try:
+            sup.tick(0)  # logged, not raised; ladder untouched
+            assert sup.level() == 0
+        finally:
+            sup.close()
