@@ -16,7 +16,11 @@ The host span tracer (``obs/trace.py``) makes the same two-sided claim —
 enabled spans are single-digit µs, disabled call sites hit the shared
 no-op ``NULL_TRACER`` for ~100 ns — so its per-span cost is measured
 here too (``span_ns_*``), plus a ring-bound check (memory can't grow
-with run length).
+with run length). The control-plane event journal (``obs/events.py``)
+gets the same treatment (``journal_*``): per-emit and per-flushed-event
+cost, with the emit cost ratioed against the measured step time and
+asserted under the 1% budget at the supervisor's worst-case one-event-
+per-step rate.
 
 CPU-runnable (8 virtual devices, the test-harness platform) so the
 numbers regenerate anywhere::
@@ -149,6 +153,52 @@ def measure_tracer() -> dict:
     }
 
 
+def measure_journal(step_time_s: float, n: int = 50_000) -> dict:
+    """Producer-side cost of the control-plane event journal
+    (``obs/events.py``): per-``emit`` ns (buffered append under a leaf
+    lock, no IO) and per-event flush ns (drain-thread side), plus the
+    emission overhead as a fraction of the measured step time at the
+    supervisor's worst-case rate (one causal event per step — a probe
+    outcome every step at ``supervisor_probe_every=1``). The journal's
+    budget is 1% of step time; emit is ~µs against ~ms steps, so the
+    assert documents the contract rather than riding the noise."""
+    import shutil
+    import tempfile
+
+    from mercury_tpu.obs.events import EventJournal
+
+    tmp = tempfile.mkdtemp(prefix="journal_bench_")
+    try:
+        journal = EventJournal(tmp, 0, capacity=n + 1)
+        detail = {"from": "sync", "to": "frozen", "reason": "bench"}
+        reps = []
+        parent = None
+        for _ in range(5):
+            t0 = time.perf_counter_ns()
+            for i in range(n // 5):
+                parent = journal.emit("supervisor/probe_failed", i,
+                                      parent=parent, detail=detail)
+            reps.append((time.perf_counter_ns() - t0) / (n // 5))
+        emit_ns = sorted(reps)[2]
+        buffered = journal.counts()["buffered"]
+        t0 = time.perf_counter_ns()
+        flushed = journal.flush()
+        flush_ns = (time.perf_counter_ns() - t0) / max(flushed, 1)
+        journal.close()
+        assert flushed == buffered
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    overhead_pct = 100.0 * (emit_ns / 1e9) / step_time_s
+    assert overhead_pct <= 1.0, (
+        f"journal emit {emit_ns:.0f} ns is {overhead_pct:.3f}% of the "
+        f"{step_time_s * 1e3:.2f} ms step — over the 1% budget")
+    return {
+        "journal_emit_ns": round(emit_ns, 1),
+        "journal_flush_ns_per_event": round(flush_ns, 1),
+        "journal_overhead_pct_per_event": round(overhead_pct, 4),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="smallcnn")
@@ -215,6 +265,7 @@ def main(argv=None) -> int:
 
     overhead_pct = 100.0 * (off.steps_per_s / on.steps_per_s - 1.0)
     tracer_cost = measure_tracer()
+    journal_cost = measure_journal(1.0 / on.steps_per_s)
     record = {
         "schema": "telemetry_overhead_v1",
         "model": args.model,
@@ -237,6 +288,7 @@ def main(argv=None) -> int:
         "off_lowered_lines": off.lowered_lines,
         "off_lowered_sha256": off.lowered_sha256,
         **tracer_cost,
+        **journal_cost,
     }
     if dist_on is not None:
         dist_overhead_pct = 100.0 * (dist_off.steps_per_s

@@ -428,6 +428,22 @@ class TrainConfig:
     # straggler trigger: max/median per-host step time above this factor
     # fires the flight recorder (multi-process only; 0 disables).
     anomaly_straggler_factor: float = 2.0
+    # Control-plane event journal (obs/events.py): append-only
+    # events.h{p}.jsonl of every supervisor/scorer/fault/elastic/
+    # checkpoint/anomaly decision with causal parent_id links, flushed
+    # on the metric writer's drain thread. Host-side only — the traced
+    # program is byte-identical either way. Needs log_dir; on-by-default
+    # because emission is a buffered dict append (~µs, measured by
+    # benchmarks/telemetry_overhead.py's journal arm).
+    event_journal: bool = True
+    # Live scrape plane (obs/serve.py): localhost HTTP endpoint with
+    # /healthz (liveness + ladder level), /statusz (manifest, ladder,
+    # tenant queues, event tail) and /metricsz (OpenMetrics text from
+    # the latest host record). 0 (default) disables — no thread, no
+    # socket; > 0 binds that port on host 0 only. Port 0 cannot request
+    # an ephemeral port from the config (use StatusServer directly in
+    # tests for that).
+    serve_port: int = 0
     log_dir: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000     # steps; 0 disables

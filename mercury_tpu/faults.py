@@ -159,11 +159,17 @@ class FaultPlane:
     workers race on it.
     """
 
-    def __init__(self, spec: str = "") -> None:
+    def __init__(self, spec: str = "", journal=None) -> None:
         self._entries = parse_fault_spec(spec)
         self._lock = threading.Lock()
         self._step = 0
         self._fired_total = 0
+        # Control-plane event journal (obs/events.py): every firing is
+        # journaled so chaos runs are self-describing. The journal's
+        # emit() takes only its own leaf lock, so calling it while
+        # holding self._lock cannot deadlock. None when journaling is
+        # off — and then firing stays allocation-free.
+        self._journal = journal
 
     # --------------------------------------------------------------- clock
     def note_step(self, step: int) -> None:
@@ -191,6 +197,15 @@ class FaultPlane:
                 if entry.every:
                     entry.next_due = step + entry.every
                 self._fired_total += 1
+                if self._journal is not None:
+                    try:
+                        self._journal.emit(
+                            "fault/fired", step,
+                            detail={"fault": entry.kind,
+                                    "fired": entry.fired,
+                                    "args": dict(entry.args)})
+                    except Exception:
+                        pass  # the plane must fire even if the journal dies
                 return dict(entry.args)
         return None
 

@@ -83,6 +83,8 @@ HOT_THREAD_MODULES = (
     "mercury_tpu/obs/writer.py",
     "mercury_tpu/obs/aggregate.py",
     "mercury_tpu/obs/anomaly.py",
+    "mercury_tpu/obs/events.py",
+    "mercury_tpu/obs/serve.py",
     "mercury_tpu/runtime/supervisor.py",
     "mercury_tpu/sampling/scorer_fleet.py",
     "mercury_tpu/sampling/scorer_service.py",

@@ -15,6 +15,16 @@ rots. This layer closes the loop statically:
   (dead registry entry, or a key built only via f-strings — e.g. the
   ``{train,test}/eval_*`` family, constructed from a split prefix).
 
+**GLM04** applies the same three-way parity contract to control-plane
+event kinds: every first-argument literal of a ``*journal*.emit(...)``
+call must be registered in ``obs/registry.py::EVENT_KINDS`` and carry a
+backticked entry in ``docs/OBSERVABILITY.md``'s kind catalog; a
+registered kind never emitted is a warning. Journal-emit first
+arguments are *excluded* from the metric-key scan — ``supervisor/…``
+event kinds share the slash grammar with metric keys, and the receiver
+name (anything containing ``journal``) is what disambiguates the two
+planes statically.
+
 Like Layer 1 this never imports the package under lint (the registry is
 read by AST ``literal_eval`` of its source), so it runs on CI machines
 with no jax installed.
@@ -45,6 +55,11 @@ _DOC_TOKEN_RE = re.compile(r"`([^`\n]+)`")
 _FENCE_RE = re.compile(r"^```.*?^```[^\S\n]*$", re.M | re.S)
 _BRACE_RE = re.compile(r"\{([^{}]+)\}")
 
+#: A control-plane event kind: exactly ``subsystem/name`` (obs/events.py
+#: schema). Only literals at journal-emit call sites are judged against
+#: this, so the broad shape cannot false-positive on paths or metrics.
+EVENT_KIND_RE = re.compile(r"^[a-z0-9_]+/[a-z0-9_]+$")
+
 #: Files whose key literals are definitional, not emissions.
 _SKIP_FILES = frozenset({"registry.py"})
 
@@ -62,10 +77,14 @@ def _default_docs_path() -> str:
     return os.path.join(_repo_root(), "docs", "API.md")
 
 
-def load_registry(path: str) -> Dict[str, str]:
-    """``METRIC_KEYS`` from the registry module's SOURCE — the dict is a
-    pure literal (enforced here by failing loudly if it is not), so no
-    import of the package (and thus no jax) is needed."""
+def _default_event_docs_path() -> str:
+    return os.path.join(_repo_root(), "docs", "OBSERVABILITY.md")
+
+
+def _load_literal(path: str, name: str) -> Dict[str, str]:
+    """A module-level pure-literal dict from SOURCE — no import of the
+    package (and thus no jax) is needed; fails loudly if missing or not
+    a literal."""
     with open(path) as f:
         tree = ast.parse(f.read(), filename=path)
     for node in tree.body:
@@ -76,9 +95,25 @@ def load_registry(path: str) -> Dict[str, str]:
         elif isinstance(node, ast.AnnAssign) and isinstance(
                 node.target, ast.Name):
             targets = [node.target.id]
-        if "METRIC_KEYS" in targets and node.value is not None:
+        if name in targets and node.value is not None:
             return ast.literal_eval(node.value)
-    raise ValueError(f"no METRIC_KEYS literal found in {path}")
+    raise ValueError(f"no {name} literal found in {path}")
+
+
+def load_registry(path: str) -> Dict[str, str]:
+    """``METRIC_KEYS`` from the registry module's source."""
+    return _load_literal(path, "METRIC_KEYS")
+
+
+def load_event_registry(path: str) -> Dict[str, str]:
+    """``EVENT_KINDS`` (the control-plane event-kind registry) from the
+    registry module's source. A registry module without one is treated
+    as an empty registry (journal emissions against it are then GLM04
+    errors), so metric-only registries stay valid."""
+    try:
+        return _load_literal(path, "EVENT_KINDS")
+    except ValueError:
+        return {}
 
 
 def _iter_py_files(paths: List[str]) -> List[str]:
@@ -95,11 +130,78 @@ def _iter_py_files(paths: List[str]) -> List[str]:
     return out
 
 
+def _receiver_name(func: ast.AST) -> str:
+    """Dotted receiver of an ``x.y.emit`` attribute chain, best-effort
+    (``self._journal.emit`` -> ``self._journal``)."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _journal_emit_args(tree: ast.AST) -> Dict[int, ast.Constant]:
+    """``id(node) -> node`` for every first-positional-argument string
+    Constant of a journal-emission call — the static signature every
+    producer call site follows: the called attribute contains ``emit``
+    and the full dotted callable name contains ``journal``
+    (``self._journal.emit(...)``, ``journal.emit(...)``, or a wrapper
+    like ``self._journal_emit(...)``)."""
+    out: Dict[int, ast.Constant] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and "emit" in node.func.attr
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        if "journal" in _receiver_name(node.func).lower():
+            out[id(node.args[0])] = node.args[0]
+    return out
+
+
+def _kind_compare_args(tree: ast.AST) -> Dict[int, ast.Constant]:
+    """String Constants compared against a ``kind`` expression
+    (``e.get("kind") == "supervisor/degrade"``, ``kind != "fault/fired"``)
+    — the *consumer*-side dual of :func:`_journal_emit_args`: event-kind
+    filters in journal readers, not metric emissions."""
+    def mentions_kind(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and "kind" in sub.id.lower():
+                return True
+            if isinstance(sub, ast.Attribute) and "kind" in sub.attr.lower():
+                return True
+            if (isinstance(sub, ast.Constant)
+                    and isinstance(sub.value, str) and sub.value == "kind"):
+                return True
+        return False
+
+    out: Dict[int, ast.Constant] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        operands = [node.left] + list(node.comparators)
+        consts = [o for o in operands
+                  if isinstance(o, ast.Constant) and isinstance(o.value, str)]
+        if consts and any(mentions_kind(o) for o in operands
+                          if not isinstance(o, ast.Constant)):
+            out.update({id(c): c for c in consts})
+    return out
+
+
 def emitted_keys(paths: List[str]) -> Dict[str, List[Tuple[str, int]]]:
     """``key -> [(file, line), ...]`` for every plain string literal in
     ``paths`` matching :data:`KEY_RE`. Constants inside f-strings are
     skipped: a JoinedStr fragment is a key *prefix*, not a key, and
-    judging it would false-positive on every dynamic tag."""
+    judging it would false-positive on every dynamic tag. Journal-emit
+    first arguments are skipped too — those are event kinds (GLM04's
+    plane), not metric keys, even when the subsystem prefix collides
+    with a metric category — as are kind-comparison literals in journal
+    consumers (the same plane, read side)."""
     found: Dict[str, List[Tuple[str, int]]] = {}
     for path in _iter_py_files(paths):
         if os.path.basename(path) in _SKIP_FILES:
@@ -112,6 +214,8 @@ def emitted_keys(paths: List[str]) -> Dict[str, List[Tuple[str, int]]]:
         skip = {id(c) for node in ast.walk(tree)
                 if isinstance(node, ast.JoinedStr)
                 for c in ast.walk(node)}
+        skip |= set(_journal_emit_args(tree))
+        skip |= set(_kind_compare_args(tree))
         for node in ast.walk(tree):
             if (isinstance(node, ast.Constant)
                     and isinstance(node.value, str)
@@ -122,8 +226,27 @@ def emitted_keys(paths: List[str]) -> Dict[str, List[Tuple[str, int]]]:
     return found
 
 
-def documented_keys(docs_path: str) -> Set[str]:
-    """Keys mentioned in backticks anywhere in the docs file, with
+def emitted_event_kinds(paths: List[str]
+                        ) -> Dict[str, List[Tuple[str, int]]]:
+    """``kind -> [(file, line), ...]`` for every journal-emit first
+    argument in ``paths`` (the GLM04 emission census)."""
+    found: Dict[str, List[Tuple[str, int]]] = {}
+    for path in _iter_py_files(paths):
+        if os.path.basename(path) in _SKIP_FILES:
+            continue
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            continue
+        for const in _journal_emit_args(tree).values():
+            found.setdefault(const.value, []).append(
+                (path, const.lineno))
+    return found
+
+
+def _documented_tokens(docs_path: str, pattern) -> Set[str]:
+    """Backticked tokens in the docs file matching ``pattern``, with
     ``{a,b,c}`` families expanded."""
     with open(docs_path) as f:
         text = _FENCE_RE.sub("", f.read())
@@ -133,18 +256,30 @@ def documented_keys(docs_path: str) -> Set[str]:
         variants = ([_BRACE_RE.sub(alt, token, count=1)
                      for alt in m.group(1).split(",")]
                     if m else [token])
-        keys.update(v for v in variants if KEY_RE.match(v))
+        keys.update(v for v in variants if pattern.match(v))
     return keys
+
+
+def documented_keys(docs_path: str) -> Set[str]:
+    """Metric keys mentioned in backticks anywhere in the docs file."""
+    return _documented_tokens(docs_path, KEY_RE)
+
+
+def documented_event_kinds(docs_path: str) -> Set[str]:
+    """Event kinds mentioned in backticks in the event docs file."""
+    return _documented_tokens(docs_path, EVENT_KIND_RE)
 
 
 def run_metrics_check(paths: List[str] = None,
                       registry_path: str = None,
-                      docs_path: str = None
+                      docs_path: str = None,
+                      event_docs_path: str = None
                       ) -> Tuple[List[str], List[str]]:
     """The Layer M audit; returns ``(errors, warnings)`` of formatted
     finding lines (the Layer 2/3 CLI contract)."""
     registry_path = registry_path or _default_registry_path()
     docs_path = docs_path or _default_docs_path()
+    event_docs_path = event_docs_path or _default_event_docs_path()
     if not paths:
         paths = [os.path.join(_repo_root(), "mercury_tpu")]
     registry = load_registry(registry_path)
@@ -173,4 +308,27 @@ def run_metrics_check(paths: List[str] = None,
                 f"GLM03 registered metric key {key!r} never appears as "
                 "a literal in the package (f-string-built or dead "
                 "entry)")
+
+    # GLM04: event-kind parity — emitted ⊆ EVENT_KINDS ⊆ documented.
+    kinds = load_event_registry(registry_path)
+    emitted_kinds = emitted_event_kinds(paths)
+    documented_kinds = documented_event_kinds(event_docs_path)
+    for kind in sorted(emitted_kinds):
+        if kind not in kinds:
+            f, line = emitted_kinds[kind][0]
+            errors.append(
+                f"{os.path.relpath(f, root)}:{line}: GLM04 event kind "
+                f"{kind!r} is not in obs/registry.py::EVENT_KINDS "
+                f"({len(emitted_kinds[kind])} emit(s)) — register and "
+                "document it, or fix the typo")
+    for kind in sorted(kinds):
+        if kind not in documented_kinds:
+            errors.append(
+                f"{os.path.relpath(event_docs_path, root)}: GLM04 "
+                f"registered event kind {kind!r} has no backticked "
+                "entry in the event-kind catalog — add it")
+        if kind not in emitted_kinds:
+            warnings.append(
+                f"GLM04 registered event kind {kind!r} is never "
+                "emitted by a journal call site (dead registry entry)")
     return errors, warnings

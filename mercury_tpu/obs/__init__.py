@@ -23,7 +23,12 @@ Layers (see ``docs/DESIGN.md`` §15 and ``docs/OBSERVABILITY.md``):
 5. :mod:`~mercury_tpu.obs.registry` — the central metric-key registry;
    every tag the training path emits must be listed there (enforced by
    ``python -m mercury_tpu.lint --layer metrics``).
-6. :mod:`~mercury_tpu.obs.aggregate` / :mod:`~mercury_tpu.obs.profile_parse`
+6. :mod:`~mercury_tpu.obs.events` / :mod:`~mercury_tpu.obs.serve` —
+   the control-plane black box: the append-only causal event journal
+   (``events.h{p}.jsonl``, every supervisor/scorer/fault/elastic/
+   checkpoint/anomaly decision with ``parent_id`` links) and the live
+   ``/healthz`` + ``/statusz`` + ``/metricsz`` scrape endpoint.
+7. :mod:`~mercury_tpu.obs.aggregate` / :mod:`~mercury_tpu.obs.profile_parse`
    / :mod:`~mercury_tpu.obs.report` — layer 3: cross-host shard
    aggregation (``host/*`` metrics + straggler detection), offline
    device-time attribution of profiler captures, and the run-report /
@@ -47,11 +52,25 @@ _LAZY_ATTRS = {
     "AnomalyEngine": "anomaly",
     "device_memory_stats": "anomaly",
     "METRIC_KEYS": "registry",
+    "EVENT_KINDS": "registry",
     "RECORD_FIELDS": "registry",
     "is_registered": "registry",
     "NULL_TRACER": "trace",
     "NullTracer": "trace",
     "SpanTracer": "trace",
+    "journal_lane_events": "trace",
+    "merge_events_into_trace": "trace",
+    "EVENT_SCHEMA": "events",
+    "EventJournal": "events",
+    "journal_filename": "events",
+    "load_events": "events",
+    "parent_chain": "events",
+    "read_journal": "events",
+    "validate_event": "events",
+    "OPENMETRICS_CONTENT_TYPE": "serve",
+    "StatusServer": "serve",
+    "parse_openmetrics": "serve",
+    "render_openmetrics": "serve",
     "PEAK_FLOPS": "accounting",
     "ThroughputMeter": "accounting",
     "analytic_flops_per_step": "accounting",
@@ -136,15 +155,33 @@ if TYPE_CHECKING:  # static analyzers see the real names
         scope_frac_metrics,
         write_breakdown,
     )
+    from mercury_tpu.obs.events import (  # noqa: F401
+        EVENT_SCHEMA,
+        EventJournal,
+        journal_filename,
+        load_events,
+        parent_chain,
+        read_journal,
+        validate_event,
+    )
     from mercury_tpu.obs.registry import (  # noqa: F401
+        EVENT_KINDS,
         METRIC_KEYS,
         RECORD_FIELDS,
         is_registered,
+    )
+    from mercury_tpu.obs.serve import (  # noqa: F401
+        OPENMETRICS_CONTENT_TYPE,
+        StatusServer,
+        parse_openmetrics,
+        render_openmetrics,
     )
     from mercury_tpu.obs.trace import (  # noqa: F401
         NULL_TRACER,
         NullTracer,
         SpanTracer,
+        journal_lane_events,
+        merge_events_into_trace,
     )
     from mercury_tpu.obs.writer import (  # noqa: F401
         AsyncMetricWriter,

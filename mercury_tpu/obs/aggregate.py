@@ -162,6 +162,14 @@ class HostShardAggregator:
         offset = self._offsets.get(path, 0)
         try:
             size = os.path.getsize(path)
+            if size < offset:
+                # The shard shrank: size-capped rotation replaced it with
+                # a fresh file (HeartbeatShardSink). Restart from byte 0
+                # and drop any buffered partial line — it belonged to the
+                # pre-rotation file and its tail will never arrive.
+                offset = 0
+                self._offsets[path] = 0
+                self._partial.pop(path, None)
             if size <= offset:
                 return
             with open(path, "r") as f:

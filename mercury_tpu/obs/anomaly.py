@@ -146,6 +146,7 @@ class AnomalyEngine:
         tracer=None,
         context_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         profile_steps: int = 0,
+        journal=None,
     ) -> None:
         if ring_steps < 1:
             raise ValueError(f"ring_steps must be >= 1, got {ring_steps}")
@@ -164,6 +165,8 @@ class AnomalyEngine:
         self.tracer = tracer
         self.context_fn = context_fn
         self.profile_steps = int(profile_steps)
+        # Control-plane event journal (obs/events.py); None when off.
+        self.journal = journal
 
         self.triggers = 0
         self.trigger_counts: Dict[str, int] = {}
@@ -343,11 +346,23 @@ class AnomalyEngine:
         _log.warning("anomaly trigger %s at step %d: %s", kind, step, detail)
         if self.tracer is not None:
             self.tracer.instant(f"anomaly/{kind}", cat="anomaly", step=step)
-        if debounced:
-            return
-        path = self.dump_flight_record(kind, step, detail)
-        if path:
-            _log.warning("flight record written: %s", path)
+        path = None
+        if not debounced:
+            path = self.dump_flight_record(kind, step, detail)
+            if path:
+                _log.warning("flight record written: %s", path)
+        if self.journal is not None:
+            try:
+                # Debounced triggers are journaled too: the journal is
+                # the decision audit, and "fired but suppressed" is a
+                # decision. The flight-record path (when one was dumped)
+                # rides in detail so the DAG links to the full dump.
+                self.journal.emit(
+                    "anomaly/triggered", step,
+                    detail={"trigger": kind, "debounced": bool(debounced),
+                            "flight_record": path})
+            except Exception:
+                pass  # journal failures never take down the engine
 
     def dump_flight_record(self, kind: str, step: int,
                            detail: Optional[Dict[str, Any]] = None

@@ -1,0 +1,272 @@
+"""Control-plane event journal: the run's causal black box.
+
+Every *decision* the control plane makes — supervisor ladder
+transitions, SLO latch/release, restarts, scorer-service tenant
+admission/wedge/starvation, snapshot epochs, injected faults, elastic
+reshards, checkpoint generations, anomaly triggers — is appended to a
+per-host, schema-versioned journal ``events.h{p}.jsonl``. Metrics say
+*what* the run looked like; the journal says *why* it ended up there:
+each event carries a ``parent_id`` naming the event that caused it, so
+a ladder walk async→…→uniform is reconstructable as a chain rooted at
+the SLO breach (or fault) that started the episode.
+
+Design constraints, in order:
+
+- **Producers never block and never do IO.** ``emit`` serializes the
+  event under a private leaf-level lock (it acquires no other lock, so
+  it is safe to call while holding the fault-plane or supervisor locks)
+  into a bounded in-memory buffer. Actual file writes happen in
+  :meth:`flush`, invoked from the ``AsyncMetricWriter`` drain thread's
+  flush-on-idle path — the same thread that already owns metric-sink
+  IO — and once more at close.
+- **Whole-line appends.** ``flush`` writes complete ``\\n``-terminated
+  lines, so a crash can tear at most the final line and
+  :func:`read_journal` (torn-line tolerant, like the heartbeat tailer)
+  recovers everything durable.
+- **Host-side only.** Nothing here touches jax; emitting an event can
+  never perturb the traced program. The module imports stdlib only so
+  offline consumers (``obs/report.py``, ``obs/serve.py``, CI
+  validators) run on jax-free machines.
+
+Event kinds are registered in ``obs/registry.py::EVENT_KINDS`` and
+documented in ``docs/OBSERVABILITY.md`` — graftlint rule GLM04 enforces
+the three-way parity, same contract as the metric keys.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: Schema tag stamped on the journal header line (first line of every
+#: shard). Bump on any incompatible field change.
+EVENT_SCHEMA = "mercury_events_v1"
+
+#: Required fields of every event row, in canonical order.
+EVENT_FIELDS = ("event_id", "parent_id", "kind", "step", "mono_ns",
+                "wall_s", "host", "detail")
+
+#: Producer-side buffer bound: control-plane events are low-rate
+#: (decisions, not samples), so this is a runaway guard, not a tuning
+#: knob. Oldest events drop first; drops are counted and surfaced.
+DEFAULT_CAPACITY = 8192
+
+
+def journal_filename(process_index: int) -> str:
+    """Journal shard name for one host (mirrors ``shard_filename``)."""
+    return f"events.h{int(process_index)}.jsonl"
+
+
+class EventJournal:
+    """Append-only per-host event journal with buffered emit and
+    drain-thread flush.
+
+    Thread contract: ``emit`` may be called from any thread (trainer,
+    supervisor poll, scorer workers, writer drain) — its lock is a leaf
+    and the body never blocks. ``flush`` is expected on the metric
+    writer's drain thread (or any single janitor thread); concurrent
+    calls are safe but ordering between them is arbitrary. ``close`` is
+    trainer-owned.
+    """
+
+    def __init__(self, log_dir: str, host: int = 0, *,
+                 capacity: int = DEFAULT_CAPACITY):
+        self._host = int(host)
+        self._capacity = int(capacity)
+        self._lock = threading.Lock()  # leaf lock: never acquires others
+        self._seq = 0
+        self._buf: List[str] = []
+        # Last-N event ring for /statusz: survives flushes (the buffer
+        # drains to disk, this keeps the live tail readable in-process).
+        self._recent: deque = deque(maxlen=64)
+        self._emitted = 0
+        self._dropped = 0
+        self._closed = False
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(log_dir, journal_filename(self._host))
+        self._f = open(self.path, "a")
+        header = {"schema": EVENT_SCHEMA, "host": self._host,
+                  "wall_s": time.time()}
+        self._f.write(json.dumps(header) + "\n")
+        self._f.flush()
+
+    # ------------------------------------------------------------- emit
+    def emit(self, kind: str, step: int = -1, *,
+             parent: Optional[str] = None,
+             detail: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Record one event; returns its ``event_id`` (for use as a
+        later event's ``parent``), or None if the journal is closed.
+
+        ``step`` is the trainer step the decision is attributed to (-1
+        when there is no meaningful step, e.g. construction-time
+        events). ``detail`` must be a JSON-able dict; non-serializable
+        leaves degrade to ``str`` rather than raising on a producer
+        thread.
+        """
+        mono_ns = time.monotonic_ns()
+        wall_s = time.time()
+        with self._lock:
+            if self._closed:
+                return None
+            eid = f"e{self._host}-{self._seq}"
+            self._seq += 1
+            evt = {
+                "event_id": eid,
+                "parent_id": parent,
+                "kind": str(kind),
+                "step": int(step),
+                "mono_ns": mono_ns,
+                "wall_s": wall_s,
+                "host": self._host,
+                "detail": detail if detail is not None else {},
+            }
+            try:
+                line = json.dumps(evt, default=str)
+            except (TypeError, ValueError):
+                evt["detail"] = {"unserializable": repr(detail)}
+                line = json.dumps(evt, default=str)
+            if len(self._buf) >= self._capacity:
+                self._buf.pop(0)
+                self._dropped += 1
+            self._buf.append(line)
+            self._recent.append(evt)
+            self._emitted += 1
+            return eid
+
+    # ------------------------------------------------------- flush/close
+    def flush(self) -> int:
+        """Write every buffered event as whole lines; returns the count.
+        Called on the metric writer's drain thread (flush-on-idle) and
+        from :meth:`close`."""
+        with self._lock:
+            if self._f is None or not self._buf:
+                return 0
+            n = len(self._buf)
+            self._f.write("\n".join(self._buf) + "\n")
+            self._buf.clear()
+            self._f.flush()
+            return n
+
+    def close(self) -> None:
+        """Final flush + file close. Emits after close are dropped."""
+        with self._lock:
+            self._closed = True
+            if self._f is None:
+                return
+            if self._buf:
+                self._f.write("\n".join(self._buf) + "\n")
+                self._buf.clear()
+            self._f.flush()
+            self._f.close()
+            self._f = None
+
+    # ------------------------------------------------------------ stats
+    def tail(self, n: int = 20) -> List[Dict[str, Any]]:
+        """The last ``n`` emitted events (most recent last), regardless
+        of flush state — the ``/statusz`` event feed."""
+        with self._lock:
+            recent = list(self._recent)
+        n = max(int(n), 0)
+        return recent[-n:] if n else []
+
+    def counts(self) -> Dict[str, int]:
+        """Emission counters for ``/statusz`` and tests."""
+        with self._lock:
+            return {"emitted": self._emitted, "dropped": self._dropped,
+                    "buffered": len(self._buf)}
+
+
+# ----------------------------------------------------------- consumers
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """All durable events of one shard, in append order. Skips the
+    header line, blank lines, and a torn final line — never raises on a
+    half-written journal (crashed runs are exactly when it matters)."""
+    events: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail
+                if not isinstance(row, dict) or "schema" in row:
+                    continue  # header (or foreign) line
+                events.append(row)
+    except OSError:
+        return []
+    return events
+
+
+def load_events(run_dir: str) -> List[Dict[str, Any]]:
+    """Merge every ``events.h*.jsonl`` shard in a run directory into one
+    list ordered by wall-clock time (stable within a host)."""
+    merged: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith("events.h") and name.endswith(".jsonl"):
+            merged.extend(read_journal(os.path.join(run_dir, name)))
+    merged.sort(key=lambda e: (e.get("wall_s", 0.0), str(e.get("event_id"))))
+    return merged
+
+
+def validate_event(evt: Dict[str, Any], *,
+                   registry: Optional[Dict[str, str]] = None) -> List[str]:
+    """Schema check for one event row; returns a list of problems
+    (empty = valid). With ``registry`` (``EVENT_KINDS``), also rejects
+    unregistered kinds — the CI journal validator passes it."""
+    problems: List[str] = []
+    if not isinstance(evt, dict):
+        return ["event is not an object"]
+    for field in EVENT_FIELDS:
+        if field not in evt:
+            problems.append(f"missing field {field!r}")
+    if problems:
+        return problems
+    if not isinstance(evt["event_id"], str) or not evt["event_id"]:
+        problems.append("event_id must be a non-empty string")
+    if evt["parent_id"] is not None and not isinstance(evt["parent_id"], str):
+        problems.append("parent_id must be null or a string")
+    kind = evt["kind"]
+    if not isinstance(kind, str) or kind.count("/") != 1:
+        problems.append(f"kind {kind!r} must be 'subsystem/name'")
+    elif registry is not None and kind not in registry:
+        problems.append(f"kind {kind!r} not in EVENT_KINDS registry")
+    if not isinstance(evt["step"], int):
+        problems.append("step must be an int")
+    if not isinstance(evt["mono_ns"], int):
+        problems.append("mono_ns must be an int")
+    if not isinstance(evt["wall_s"], (int, float)):
+        problems.append("wall_s must be a number")
+    if not isinstance(evt["host"], int):
+        problems.append("host must be an int")
+    if not isinstance(evt["detail"], dict):
+        problems.append("detail must be an object")
+    return problems
+
+
+def parent_chain(events: List[Dict[str, Any]],
+                 event_id: str) -> List[Dict[str, Any]]:
+    """Walk ``parent_id`` links from ``event_id`` back to the root;
+    returns the chain root-first. Cycles (corrupt journals) terminate
+    rather than loop."""
+    by_id = {e["event_id"]: e for e in events if "event_id" in e}
+    chain: List[Dict[str, Any]] = []
+    seen: set = set()
+    cur = by_id.get(event_id)
+    while cur is not None and cur["event_id"] not in seen:
+        seen.add(cur["event_id"])
+        chain.append(cur)
+        parent = cur.get("parent_id")
+        cur = by_id.get(parent) if parent else None
+    chain.reverse()
+    return chain

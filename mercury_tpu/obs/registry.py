@@ -209,6 +209,51 @@ METRIC_KEYS: Dict[str, str] = {
         "cumulative failed checkpoint write attempts (retries included)",
 }
 
+#: Control-plane event kinds (``obs/events.py`` journal rows). Same
+#: contract as METRIC_KEYS: a PURE literal (graftlint Layer M parses it
+#: with ``ast.literal_eval``), every kind emitted somewhere in the
+#: package (GLM04 errors otherwise), every kind documented in the
+#: docs/OBSERVABILITY.md kind catalog. ``subsystem/name`` shape; the
+#: subsystem names the journal lane in the merged Perfetto timeline.
+EVENT_KINDS: Dict[str, str] = {
+    # supervisor/* — ladder + restart lifecycle (runtime/supervisor.py)
+    "supervisor/slo_breach":
+        "a registered SLO latched (rising edge); roots a breach episode",
+    "supervisor/slo_release":
+        "a latched SLO stopped breaching; parent = the breach event",
+    "supervisor/degrade":
+        "one-level ladder descent; parent = breach/exhaustion/probe event",
+    "supervisor/recover":
+        "one-level ladder ascent; parent = the successful probe",
+    "supervisor/restart": "a dead host unit was restarted successfully",
+    "supervisor/restart_failed": "a unit restart attempt raised",
+    "supervisor/exhausted":
+        "a unit ran out of restart budget; parent = the failed restart",
+    "supervisor/probe_ok":
+        "recovery probe succeeded; parent = the degrade it is probing",
+    "supervisor/probe_failed":
+        "recovery probe raised; parent = the degrade it is probing",
+    # scorer/* — multi-tenant scorer service (sampling/scorer_service.py)
+    "scorer/tenant_admitted": "a tenant queue was admitted at startup",
+    "scorer/wedged": "a tenant was wedged by the scorer_wedge fault",
+    "scorer/starved":
+        "a tenant's staleness/queue SLO latched (starvation decision)",
+    "scorer/snapshot": "a new params snapshot opened a scoring epoch",
+    # fault/* — injection plane (faults.py); chaos runs self-describe
+    "fault/fired": "a scheduled fault fired at its hook point",
+    # elastic/* — (W, L) resharding (train/elastic.py)
+    "elastic/reshard_begin": "elastic restore started; detail has old/new W,L",
+    "elastic/reshard_end": "elastic restore finished; parent = reshard_begin",
+    # checkpoint/* — durable generations (train/checkpoint.py)
+    "checkpoint/written": "a checkpoint generation was written durably",
+    "checkpoint/verified": "a generation passed manifest verification",
+    "checkpoint/fallback":
+        "restore rejected a generation and fell back to an older one",
+    # anomaly/* — flight recorder (obs/anomaly.py)
+    "anomaly/triggered":
+        "an anomaly trigger fired; detail carries the flight-record path",
+}
+
 #: Bookkeeping fields that ride along in every record but are not metric
 #: tags (no ``prefix/`` namespace, never plotted as series of their own).
 RECORD_FIELDS = ("step", "time", "epoch")

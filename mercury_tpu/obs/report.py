@@ -36,6 +36,9 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+# Stdlib-only sibling (no jax, no numpy) — safe for offline report runs.
+from mercury_tpu.obs.events import load_events, parent_chain
+
 #: Schema tag for the tolerance-rule file.
 TOLERANCES_SCHEMA = "mercury_report_tolerances_v1"
 
@@ -107,6 +110,9 @@ def load_run(run_dir: str) -> Dict[str, Any]:
         "metrics": metrics,
         "shards": shards,
         "flight_records": flight,
+        "events": load_events(run_dir),
+        "supervisor_summary": _read_json(os.path.join(
+            run_dir, "supervisor_summary.json")),
         "breakdown": _read_json(os.path.join(
             run_dir, "device_time_breakdown.json")),
         "trace_events": (len(trace.get("traceEvents", []))
@@ -285,6 +291,92 @@ def _scorer_service_blocks(records: Sequence[Dict[str, Any]]
     return blocks
 
 
+# --------------------------------------------------- run-timeline section
+def _walk_label(evt: Dict[str, Any]) -> str:
+    """One hop of a causal walk: ``kind[to]@step`` (the ``to`` rides on
+    ladder transitions; other kinds render as plain ``kind@step``)."""
+    detail = evt.get("detail") or {}
+    qualifier = detail.get("to") or detail.get("fault") or detail.get(
+        "trigger") or detail.get("slo")
+    kind = evt.get("kind", "?")
+    if qualifier:
+        kind = f"{kind}[{qualifier}]"
+    step = evt.get("step", -1)
+    return f"{kind}@{step}" if isinstance(step, int) and step >= 0 else kind
+
+
+def _event_timeline_blocks(events: List[Dict[str, Any]]) -> List[Block]:
+    """The "Run timeline" section from the control-plane event journal:
+    a kind census, the causal DAG's linked events, and one reconstructed
+    ``parent_id`` walk per degrade episode (how the ladder was walked —
+    the journal's whole reason to exist)."""
+    blocks: List[Block] = []
+    if not events:
+        return blocks
+    hosts = sorted({e.get("host", 0) for e in events})
+    blocks.append(("h", 2, "Run timeline"))
+    blocks.append(("p", f"{len(events)} control-plane events from "
+                   f"{len(hosts)} host(s) (events.h*.jsonl)"))
+
+    census: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        kind = e.get("kind", "?")
+        row = census.setdefault(kind, {"n": 0, "first": None, "last": None})
+        row["n"] += 1
+        step = e.get("step", -1)
+        if isinstance(step, int) and step >= 0:
+            row["first"] = step if row["first"] is None else row["first"]
+            row["last"] = step
+    blocks.append(("table", ["kind", "events", "first step", "last step"],
+                   [[k, census[k]["n"],
+                     census[k]["first"] if census[k]["first"] is not None
+                     else "—",
+                     census[k]["last"] if census[k]["last"] is not None
+                     else "—"]
+                    for k in sorted(census)]))
+
+    # Episode walks: for every supervisor/degrade, walk parent_id back
+    # to the episode root (SLO breach, exhaustion, probe failure chain);
+    # keep the LONGEST walk per root — that is the full ladder descent.
+    episodes: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        if e.get("kind") != "supervisor/degrade":
+            continue
+        chain = parent_chain(events, e["event_id"])
+        root = chain[0]["event_id"] if chain else e["event_id"]
+        if len(chain) > len(episodes.get(root, [])):
+            episodes[root] = chain
+    if episodes:
+        blocks.append(("h", 3, "Degrade episodes"))
+        rows = []
+        for i, root in enumerate(sorted(
+                episodes, key=lambda r: episodes[r][0].get("wall_s", 0))):
+            chain = episodes[root]
+            walk = " → ".join(_walk_label(e) for e in chain)
+            rows.append([f"ep{i}", len(chain), walk])
+        blocks.append(("table", ["episode", "events", "causal walk"],
+                       rows))
+
+    # The DAG's linked events (parents or children), newest last — the
+    # census above already covers unlinked singletons like fault/fired.
+    parents = {e.get("parent_id") for e in events if e.get("parent_id")}
+    linked = [e for e in events
+              if e.get("parent_id") or e.get("event_id") in parents]
+    if linked:
+        cap = 60
+        shown = linked[-cap:]
+        blocks.append(("h", 3, "Causally linked events"))
+        if len(linked) > len(shown):
+            blocks.append(("p", f"last {len(shown)} of {len(linked)} "
+                           "linked events"))
+        blocks.append(("table",
+                       ["event", "kind", "step", "host", "parent"],
+                       [[e.get("event_id"), e.get("kind"),
+                         e.get("step"), e.get("host"),
+                         e.get("parent_id") or "—"] for e in shown]))
+    return blocks
+
+
 # ------------------------------------------------------------ rendering
 # Reports are built as a neutral block list so markdown and HTML render
 # from the same structure: ("h", level, text) | ("p", text) |
@@ -359,6 +451,22 @@ def _run_blocks(run: Dict[str, Any]) -> List[Block]:
         blocks.append(("kv", [
             ("h2d overlap", f"{bd['h2d']['overlap_frac']:.2%}"),
             ("idle fraction", f"{bd['idle']['idle_frac']:.2%}")]))
+    blocks.extend(_event_timeline_blocks(run["events"]))
+    summary = run.get("supervisor_summary")
+    if isinstance(summary, dict):
+        blocks.append(("h", 2, "Supervisor summary"))
+        blocks.append(("kv", [
+            ("final level",
+             f"{summary.get('level')} ({summary.get('level_name')})"),
+            ("restarts", summary.get("restarts")),
+            ("degradations", summary.get("degradations")),
+            ("recoveries", summary.get("recoveries"))]))
+        transitions = summary.get("transitions") or []
+        if transitions:
+            blocks.append(("table",
+                           ["step", "from", "to", "reason"],
+                           [[t.get("step"), t.get("from"), t.get("to"),
+                             t.get("reason")] for t in transitions]))
     if run["flight_records"]:
         blocks.append(("h", 2, "Flight records"))
         rows = [[os.path.basename(fr.get("_path", "?")),

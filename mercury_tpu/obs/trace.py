@@ -37,7 +37,89 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-__all__ = ["SpanTracer", "NULL_TRACER", "NullTracer"]
+__all__ = ["SpanTracer", "NULL_TRACER", "NullTracer",
+           "journal_lane_events", "merge_events_into_trace"]
+
+#: Synthetic Chrome ``tid`` base for the per-subsystem journal lanes.
+#: Real thread ids on linux are pthread addresses (very large), so a
+#: small fixed base cannot collide with a recorded span's tid.
+_EVENT_LANE_TID_BASE = 0xE000
+
+
+def journal_lane_events(events: List[Dict[str, Any]],
+                        epoch_unix_s: float,
+                        pid: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Convert control-plane journal rows (``obs/events.py``) into Chrome
+    trace events: one instant per event on a synthetic per-subsystem
+    lane (``events/supervisor``, ``events/fault``, ...), plus a flow
+    arrow (``ph:"s"``/``ph:"f"``) for every ``parent_id`` link — so
+    Perfetto draws the causal chain breach → degrade → probe → recover
+    on top of the span timeline.
+
+    ``epoch_unix_s`` is the span tracer's wall-clock epoch
+    (``otherData.epoch_unix_s`` of an exported trace): journal events
+    carry absolute ``wall_s`` and are aligned into the tracer's
+    microsecond timebase here. Pure stdlib — usable offline against an
+    exported ``trace.json`` + journal file (see
+    :func:`merge_events_into_trace`)."""
+    pid = os.getpid() if pid is None else pid
+    out: List[Dict[str, Any]] = []
+    lanes: Dict[str, int] = {}
+    placed: Dict[str, tuple] = {}  # event_id -> (ts_us, tid)
+    for evt in events:
+        kind = str(evt.get("kind", "?/?"))
+        subsystem = kind.split("/", 1)[0]
+        tid = lanes.setdefault(subsystem,
+                               _EVENT_LANE_TID_BASE + len(lanes))
+        ts = (float(evt.get("wall_s", epoch_unix_s)) - epoch_unix_s) * 1e6
+        eid = evt.get("event_id")
+        if isinstance(eid, str):
+            placed[eid] = (ts, tid)
+        out.append({
+            "name": kind, "cat": "events", "ph": "i", "s": "p",
+            "ts": ts, "pid": pid, "tid": tid,
+            "args": {"event_id": eid,
+                     "parent_id": evt.get("parent_id"),
+                     "step": evt.get("step"),
+                     "host": evt.get("host"),
+                     "detail": evt.get("detail")},
+        })
+    flows = 0
+    for evt in events:
+        parent, eid = evt.get("parent_id"), evt.get("event_id")
+        if not (isinstance(parent, str) and parent in placed
+                and isinstance(eid, str) and eid in placed):
+            continue
+        p_ts, p_tid = placed[parent]
+        c_ts, c_tid = placed[eid]
+        flows += 1
+        fid = f"evt-flow-{flows}"
+        out.append({"name": "causes", "cat": "events", "ph": "s",
+                    "id": fid, "ts": p_ts, "pid": pid, "tid": p_tid})
+        out.append({"name": "causes", "cat": "events", "ph": "f",
+                    "bp": "e", "id": fid, "ts": c_ts, "pid": pid,
+                    "tid": c_tid})
+    for subsystem, tid in lanes.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": f"events/{subsystem}"}})
+    return out
+
+
+def merge_events_into_trace(doc: Dict[str, Any],
+                            events: List[Dict[str, Any]]
+                            ) -> Dict[str, Any]:
+    """Offline merge: append journal lanes to an already-exported Chrome
+    trace document (mutates and returns ``doc``). The document must
+    carry ``otherData.epoch_unix_s`` (every SpanTracer export does)."""
+    other = doc.setdefault("otherData", {})
+    epoch = float(other.get("epoch_unix_s", 0.0))
+    pids = [e.get("pid") for e in doc.get("traceEvents", [])
+            if e.get("pid") is not None]
+    pid = pids[0] if pids else None
+    doc.setdefault("traceEvents", []).extend(
+        journal_lane_events(events, epoch, pid=pid))
+    other["journal_events"] = len(events)
+    return doc
 
 
 class _NullSpan:
@@ -76,7 +158,9 @@ class NullTracer:
     def snapshot(self) -> List[Dict[str, Any]]:
         return []
 
-    def export_chrome_trace(self, path: str) -> Optional[str]:
+    def export_chrome_trace(self, path: str,
+                            events: Optional[List[Dict[str, Any]]] = None
+                            ) -> Optional[str]:
         return None
 
 
@@ -180,31 +264,42 @@ class SpanTracer:
             events.append(ev)
         return events
 
-    def chrome_trace(self) -> Dict[str, Any]:
-        """The full trace document: events + thread-name metadata."""
+    def chrome_trace(self, events: Optional[List[Dict[str, Any]]] = None
+                     ) -> Dict[str, Any]:
+        """The full trace document: spans + thread-name metadata, plus —
+        when ``events`` (control-plane journal rows) is given — one
+        instant-event lane per subsystem and flow arrows for causal
+        ``parent_id`` links, all on the tracer's shared timebase."""
         pid = os.getpid()
-        events = self.snapshot()
+        trace_events = self.snapshot()
         for tid, name in list(self._thread_names.items()):
-            events.append({
+            trace_events.append({
                 "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                 "args": {"name": name},
             })
+        other: Dict[str, Any] = {
+            "tracer": "mercury_tpu.obs.trace",
+            "epoch_unix_s": self._epoch_unix,
+            "span_capacity": self.capacity,
+            "spans_recorded": self._total,
+            "spans_dropped": self.dropped,
+        }
+        if events:
+            trace_events.extend(
+                journal_lane_events(events, self._epoch_unix, pid=pid))
+            other["journal_events"] = len(events)
         return {
-            "traceEvents": events,
+            "traceEvents": trace_events,
             "displayTimeUnit": "ms",
-            "otherData": {
-                "tracer": "mercury_tpu.obs.trace",
-                "epoch_unix_s": self._epoch_unix,
-                "span_capacity": self.capacity,
-                "spans_recorded": self._total,
-                "spans_dropped": self.dropped,
-            },
+            "otherData": other,
         }
 
-    def export_chrome_trace(self, path: str) -> str:
+    def export_chrome_trace(self, path: str,
+                            events: Optional[List[Dict[str, Any]]] = None
+                            ) -> str:
         """Write the trace JSON atomically; returns the path. The file
         loads as-is in Perfetto / ``chrome://tracing``."""
-        doc = self.chrome_trace()
+        doc = self.chrome_trace(events=events)
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
         tmp = path + ".tmp"

@@ -80,7 +80,7 @@ class AsyncMetricWriter:
 
     def __init__(self, sinks: Iterable, capacity: int = 256,
                  start: bool = True, observers: Iterable = (),
-                 faults=None) -> None:
+                 faults=None, journal=None) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.sinks = [s for s in sinks if s is not None]
@@ -88,6 +88,14 @@ class AsyncMetricWriter:
         # stalls the drain thread mid-emit, exercising the drop-oldest
         # backpressure policy. None when disabled.
         self._faults = faults
+        # Control-plane event journal (obs/events.py): producers buffer
+        # events from any thread; the drain thread makes them durable at
+        # the same flush-on-idle points as the sinks. None when disabled.
+        self._journal = journal
+        # Latest fully-fanned-out host record (observers applied) — the
+        # /metricsz scrape cache. Written on the drain thread, read from
+        # the serve thread; guarded by _lock.
+        self._latest: Optional[Dict[str, float]] = None
         # Copy-on-write: add_observer() swaps in a new list under _lock
         # and _emit() snapshots it, so registration never races the
         # drain thread mid-iteration.
@@ -150,6 +158,20 @@ class AsyncMetricWriter:
         with self._lock:
             return len(self._q) + (1 if self._busy else 0)
 
+    def latest_record(self) -> Optional[Dict[str, float]]:
+        """Copy of the most recent host record after observer fan-out —
+        the feed for the ``/metricsz`` scrape endpoint. None until the
+        first record drains."""
+        with self._lock:
+            return dict(self._latest) if self._latest is not None else None
+
+    def _flush_journal(self) -> None:
+        if self._journal is not None:
+            try:
+                self._journal.flush()
+            except Exception as exc:
+                self._note_error("event journal flush failed: %s", exc)
+
     def flush(self, timeout: float = 60.0) -> None:
         """Block until every record enqueued so far has been written to
         the sinks (and ask buffered sinks to hit the filesystem)."""
@@ -170,6 +192,7 @@ class AsyncMetricWriter:
                 except Exception as exc:
                     self._note_error("sink %s flush failed: %s",
                                      type(s).__name__, exc)
+        self._flush_journal()
 
     def close(self, timeout: float = 60.0) -> None:
         """Drain, stop the thread, close every sink. Idempotent. Joins
@@ -196,6 +219,9 @@ class AsyncMetricWriter:
             except Exception as exc:
                 self._note_error("sink %s close failed: %s",
                                  type(s).__name__, exc)
+        # The journal outlives the writer (producers may still emit
+        # during trainer teardown) — flush here, the trainer closes it.
+        self._flush_journal()
 
     def __enter__(self) -> "AsyncMetricWriter":
         return self
@@ -246,6 +272,8 @@ class AsyncMetricWriter:
             except Exception as exc:
                 self._note_error("sink %s write failed at step %d: %s",
                                  type(s).__name__, step, exc)
+        with self._lock:
+            self._latest = record
 
     def _drain_pending(self) -> None:
         while True:
@@ -283,6 +311,7 @@ class AsyncMetricWriter:
                             self._note_error(
                                 "sink %s idle-flush failed: %s",
                                 type(s).__name__, exc)
+                self._flush_journal()
 
 
 def host_thread_stats() -> Dict[str, float]:
@@ -368,17 +397,47 @@ class HeartbeatShardSink:
     wedged host's heartbeat shard is current up to its very last logged
     record, so "when did host 3 stop?" has an answer even after a
     SIGKILL. Rows carry only the liveness subset of keys, so the cost
-    stays one short line per log tick (on the drain thread)."""
+    stays one short line per log tick (on the drain thread).
+
+    Growth is bounded: when the shard would exceed ``max_bytes`` it is
+    rotated to ``<name>.1`` (one prior generation kept, older ones
+    overwritten) and a fresh shard started — a long flush-per-write run
+    can no longer grow the file without limit. The cross-host
+    aggregator's byte-offset tailer detects the post-rotation shrink
+    and restarts from offset 0, dropping any torn partial line from the
+    pre-rotation file (see ``HostShardAggregator._tail_shard``)."""
 
     _KEYS = ("time/step", "data/stall_s", "data/queue_depth",
              "obs/dropped", "anomaly/triggers", "host/straggler_ratio",
              "threads/alive")
 
-    def __init__(self, log_dir: str, process_index: int) -> None:
+    #: Rotation threshold. Heartbeat rows are ~200 bytes, so the default
+    #: keeps ~2 × 20k rows of history per host. ``0`` disables rotation.
+    DEFAULT_MAX_BYTES = 4 * 1024 * 1024
+
+    def __init__(self, log_dir: str, process_index: int,
+                 max_bytes: int = DEFAULT_MAX_BYTES) -> None:
         os.makedirs(log_dir, exist_ok=True)
         self.process_index = int(process_index)
+        self.max_bytes = int(max_bytes)
+        self.rotations = 0
         name = f"heartbeat.h{self.process_index}.jsonl"
-        self._f = open(os.path.join(log_dir, name), "a")
+        self._path = os.path.join(log_dir, name)
+        self._f = open(self._path, "a")
+        try:
+            self._size = os.path.getsize(self._path)
+        except OSError:
+            self._size = 0
+
+    def _rotate(self) -> None:
+        self._f.close()
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError:
+            pass  # rotation is best-effort; keep appending regardless
+        self._f = open(self._path, "a")
+        self._size = 0
+        self.rotations += 1
 
     def write(self, record: Dict[str, float]) -> None:
         if self._f is None:
@@ -389,8 +448,13 @@ class HeartbeatShardSink:
         for key in self._KEYS:
             if key in record:
                 row[key] = record[key]
-        self._f.write(json.dumps(row) + "\n")
+        line = json.dumps(row) + "\n"
+        if (self.max_bytes > 0 and self._size > 0
+                and self._size + len(line) > self.max_bytes):
+            self._rotate()
+        self._f.write(line)
         self._f.flush()
+        self._size += len(line)
 
     def close(self) -> None:
         if self._f is not None:

@@ -61,7 +61,8 @@ LEVEL_NAMES = ("async", "sync", "frozen", "uniform")
 class _Slo:
     """One registered service-level objective (mutable breach latch)."""
 
-    __slots__ = ("name", "check_fn", "breached", "breaches")
+    __slots__ = ("name", "check_fn", "breached", "breaches",
+                 "episode_event")
 
     def __init__(self, name: str,
                  check_fn: Callable[[], Optional[str]]) -> None:
@@ -69,6 +70,10 @@ class _Slo:
         self.check_fn = check_fn
         self.breached = False   # rising-edge latch: one degrade per event
         self.breaches = 0
+        # Journal event id of the breach that opened the current episode;
+        # the degrade it causes and the eventual release both parent to
+        # it, so the whole episode is one chain in the event DAG.
+        self.episode_event: Optional[str] = None
 
 
 class _Unit:
@@ -76,7 +81,7 @@ class _Unit:
 
     __slots__ = ("name", "alive_fn", "restart_fn", "escalates",
                  "restarts_used", "next_restart_t", "exhausted_handled",
-                 "last_alive_t", "down_since_t")
+                 "last_alive_t", "down_since_t", "last_fail_event")
 
     def __init__(self, name: str, alive_fn: Callable[[], bool],
                  restart_fn: Callable[[], None], escalates: bool) -> None:
@@ -89,6 +94,9 @@ class _Unit:
         self.exhausted_handled = False
         self.last_alive_t = time.monotonic()
         self.down_since_t: Optional[float] = None
+        # Journal event id of this unit's most recent failed restart —
+        # the causal parent of a later exhaustion event.
+        self.last_fail_event: Optional[str] = None
 
 
 class HostSupervisor:
@@ -107,11 +115,17 @@ class HostSupervisor:
 
     def __init__(self, *, restart_budget: int = 3, backoff_s: float = 0.5,
                  probe_every: int = 200, poll_s: float = 0.0,
-                 anomaly=None) -> None:
+                 anomaly=None, journal=None) -> None:
         self._budget = max(int(restart_budget), 0)
         self._backoff_s = max(float(backoff_s), 0.0)
         self._probe_every = max(int(probe_every), 0)
         self._anomaly = anomaly
+        # Control-plane event journal (obs/events.py); None when off.
+        # Its emit() is buffered, lock-leaf, and never blocks a tick.
+        self._journal = journal
+        # Event id of the most recent degrade — the causal parent of the
+        # recovery probes (and their outcomes) that follow it.
+        self._last_degrade_event: Optional[str] = None
         self._units: List[_Unit] = []
         self._slos: List[_Slo] = []
         self._probe_fn: Optional[Callable[[], None]] = None
@@ -217,16 +231,30 @@ class HostSupervisor:
                 continue
             with self._lock:
                 rising = status is not None and not slo.breached
+                falling = status is None and slo.breached
                 slo.breached = status is not None
                 if rising:
                     slo.breaches += 1
+                episode = slo.episode_event
+                if falling:
+                    slo.episode_event = None
             if rising:
                 _log.warning("supervisor: SLO %s breached at step %d: %s",
                              slo.name, step, status)
                 self._flight("supervisor_slo_breach", step, {
                     "slo": slo.name, "status": status,
                 })
-                self._degrade(step, f"SLO {slo.name} breached: {status}")
+                breach_eid = self._journal_emit(
+                    "supervisor/slo_breach", step,
+                    detail={"slo": slo.name, "status": status})
+                with self._lock:
+                    slo.episode_event = breach_eid
+                self._degrade(step, f"SLO {slo.name} breached: {status}",
+                              parent=breach_eid)
+            elif falling:
+                self._journal_emit(
+                    "supervisor/slo_release", step, parent=episode,
+                    detail={"slo": slo.name})
 
     def request_restart(self, name: str, step: int) -> bool:
         """Synchronous restart of one unit (the pop()-failed hot path:
@@ -249,11 +277,13 @@ class HostSupervisor:
         return self._try_restart(unit, step)
 
     def report_failure(self, source: str, step: int,
-                       exc: BaseException) -> None:
+                       exc: BaseException,
+                       parent: Optional[str] = None) -> None:
         """A degraded-path action failed on the trainer thread (e.g. the
-        level-1 sync refresh raised): escalate one level."""
+        level-1 sync refresh raised): escalate one level. ``parent``
+        optionally names the journal event that caused the failure."""
         self._degrade(step, f"{source} failed: "
-                            f"{type(exc).__name__}: {exc}")
+                            f"{type(exc).__name__}: {exc}", parent=parent)
 
     # ------------------------------------------------------ unit handling
     def _find(self, name: str) -> Optional[_Unit]:
@@ -302,6 +332,13 @@ class HostSupervisor:
                 "budget": self._budget,
                 "error": f"{type(exc).__name__}: {exc}",
             })
+            fail_eid = self._journal_emit(
+                "supervisor/restart_failed", step,
+                detail={"unit": unit.name, "attempt": attempt,
+                        "budget": self._budget,
+                        "error": f"{type(exc).__name__}: {exc}"})
+            with self._lock:
+                unit.last_fail_event = fail_eid
             return False
         with self._lock:
             unit.down_since_t = None
@@ -311,6 +348,10 @@ class HostSupervisor:
         self._flight("supervisor_restart", step, {
             "unit": unit.name, "attempt": attempt, "budget": self._budget,
         })
+        self._journal_emit(
+            "supervisor/restart", step,
+            detail={"unit": unit.name, "attempt": attempt,
+                    "budget": self._budget})
         return True
 
     def _note_exhausted(self, unit: _Unit, step: int) -> None:
@@ -319,9 +360,15 @@ class HostSupervisor:
                 return
             unit.exhausted_handled = True
             escalates = unit.escalates
+            fail_eid = unit.last_fail_event
+        exhausted_eid = self._journal_emit(
+            "supervisor/exhausted", step, parent=fail_eid,
+            detail={"unit": unit.name, "budget": self._budget,
+                    "escalates": escalates})
         if escalates:
             self._degrade(step, f"{unit.name} restart budget "
-                                f"({self._budget}) exhausted")
+                                f"({self._budget}) exhausted",
+                          parent=exhausted_eid)
         else:
             _log.warning(
                 "supervisor: %s is down with its restart budget (%d) "
@@ -332,7 +379,8 @@ class HostSupervisor:
             })
 
     # ------------------------------------------------------------- ladder
-    def _degrade(self, step: int, reason: str) -> None:
+    def _degrade(self, step: int, reason: str,
+                 parent: Optional[str] = None) -> None:
         with self._lock:
             if self._level >= len(LEVEL_NAMES) - 1:
                 return
@@ -350,8 +398,15 @@ class HostSupervisor:
             "from": LEVEL_NAMES[src], "to": LEVEL_NAMES[dst],
             "reason": reason,
         })
+        eid = self._journal_emit(
+            "supervisor/degrade", step, parent=parent,
+            detail={"from": LEVEL_NAMES[src],
+                    "to": LEVEL_NAMES[dst], "reason": reason})
+        with self._lock:
+            self._last_degrade_event = eid
 
-    def _recover(self, step: int, reason: str) -> None:
+    def _recover(self, step: int, reason: str,
+                 parent: Optional[str] = None) -> None:
         with self._lock:
             if self._level <= 0:
                 return
@@ -376,6 +431,10 @@ class HostSupervisor:
             "from": LEVEL_NAMES[src], "to": LEVEL_NAMES[dst],
             "reason": reason,
         })
+        self._journal_emit(
+            "supervisor/recover", step, parent=parent,
+            detail={"from": LEVEL_NAMES[src],
+                    "to": LEVEL_NAMES[dst], "reason": reason})
 
     def _maybe_probe(self, step: int) -> None:
         with self._lock:
@@ -393,6 +452,8 @@ class HostSupervisor:
             level = self._level
         if not due or probe is None:
             return
+        with self._lock:
+            degrade_eid = self._last_degrade_event
         try:
             if level == 1 and revive is not None:
                 # The last climb needs live workers, not just a working
@@ -400,9 +461,16 @@ class HostSupervisor:
                 revive()
             probe()
         except Exception as exc:
-            self.report_failure("recovery probe", step, exc)
+            peid = self._journal_emit(
+                "supervisor/probe_failed", step, parent=degrade_eid,
+                detail={"level": level, "level_name": LEVEL_NAMES[level],
+                        "error": f"{type(exc).__name__}: {exc}"})
+            self.report_failure("recovery probe", step, exc, parent=peid)
             return
-        self._recover(step, "recovery probe succeeded")
+        peid = self._journal_emit(
+            "supervisor/probe_ok", step, parent=degrade_eid,
+            detail={"level": level, "level_name": LEVEL_NAMES[level]})
+        self._recover(step, "recovery probe succeeded", parent=peid)
 
     # ------------------------------------------------- observer / monitor
     def observe_record(self, record: Dict[str, float]) -> None:
@@ -450,6 +518,23 @@ class HostSupervisor:
             self._thread.join(timeout=timeout)
 
     # ----------------------------------------------------------- telemetry
+    def _journal_emit(self, kind: str, step: int,
+                      parent: Optional[str] = None,
+                      detail: Optional[Dict[str, Any]] = None
+                      ) -> Optional[str]:
+        """Journal one control-plane event; returns its id (the causal
+        parent for follow-on events) or None when journaling is off.
+        Never raises — a full/failed journal must not affect the ladder."""
+        if self._journal is None:
+            return None
+        try:
+            return self._journal.emit(kind, step, parent=parent,
+                                      detail=detail)
+        except Exception as exc:  # defensive: journal never takes us down
+            _log.warning("supervisor: journal emit %s failed: %s",
+                         kind, exc)
+            return None
+
     def _flight(self, kind: str, step: int, detail: Dict[str, Any]) -> None:
         if self._anomaly is None:
             return

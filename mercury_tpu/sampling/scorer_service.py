@@ -207,8 +207,13 @@ class ScorerService:
         tracer=None,
         faults=None,
         train_mesh=None,
+        journal=None,
     ) -> None:
         validate_scorer_composition(config, jax.process_count())
+        # Control-plane event journal (obs/events.py); None when off.
+        # emit() is buffered + leaf-locked: safe under self._lock, off
+        # the scoring hot path.
+        self._journal = journal
         self._x = np.asarray(x_train)
         self._y = np.asarray(y_train)
         self._shard_indices = np.asarray(shard_indices)
@@ -239,6 +244,13 @@ class ScorerService:
             _Tenant(i, weights[i], queue_max)
             for i in range(int(config.scorer_tenants))
         ]
+        if self._journal is not None:
+            for t in self._tenants:
+                self._journal.emit(
+                    "scorer/tenant_admitted", -1,
+                    detail={"tenant": t.name, "weight": t.weight,
+                            "queue_max": queue_max,
+                            "backend": self._backend})
 
         # Deterministic multi-process mode (device backend only; the
         # composition validator pinned tenants == workers == 1).
@@ -384,10 +396,15 @@ class ScorerService:
                         wedge_idx = int(args.get("tenant", 0))
                         with self._lock:
                             self._tenants[wedge_idx].wedged = True
+                            last_step = self._last_step
                         _log.warning(
                             "scorer_wedge injected: tenant t%d frozen "
                             "(staleness SLO takes it from here)",
                             wedge_idx)
+                        if self._journal is not None:
+                            self._journal.emit(
+                                "scorer/wedged", last_step,
+                                detail={"tenant": f"t{wedge_idx}"})
                 t = self._next_tenant()
                 if t is None:
                     # Nothing eligible: park until a producer signals
@@ -459,7 +476,12 @@ class ScorerService:
                 t.snap = (snap[0], snap[1], int(step))
                 t.scored_in_epoch = 0
             self._snapshots += 1
+            snapshots = self._snapshots
             self._last_step = int(step)
+        if self._journal is not None:
+            self._journal.emit(
+                "scorer/snapshot", int(step),
+                detail={"epoch": snapshots, "tenants": len(self._tenants)})
         self._work.set()
         if self._lockstep and self._exc is None and not self._closed:
             self._ls_done.clear()
@@ -535,7 +557,9 @@ class ScorerService:
     def drain(self) -> List[ScoreChunk]:
         """Fleet-compatible drain (uses the last known step for the
         staleness clock; the trainer calls :meth:`drain_for_step`)."""
-        return self.drain_for_step(self._last_step)
+        with self._lock:
+            step = self._last_step
+        return self.drain_for_step(step)
 
     def slo_status(self, step: int) -> Optional[str]:
         """Current SLO breach description, or None when healthy.
@@ -563,6 +587,14 @@ class ScorerService:
                     if not t.slo_latched:
                         t.slo_latched = True
                         t.slo_breaches += 1
+                        if self._journal is not None:
+                            # Rising edge only: the starvation DECISION,
+                            # not the per-tick breach status.
+                            self._journal.emit(
+                                "scorer/starved", step,
+                                detail={"tenant": t.name,
+                                        "reasons": list(reasons),
+                                        "wedged": t.wedged})
                     breaches.append(f"{t.name}: " + ", ".join(reasons))
                 else:
                     t.slo_latched = False

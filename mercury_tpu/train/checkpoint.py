@@ -126,7 +126,7 @@ def _host_gather(tree: Any) -> Any:
 def save_checkpoint(directory: str, state: Any, step: int, *,
                     keep: int = 0, retries: int = 0,
                     retry_backoff_s: float = 0.25, manifest: bool = False,
-                    faults=None) -> str:
+                    faults=None, journal=None) -> str:
     """Save ``state`` under ``directory/ckpt_<step>``.
 
     Multi-controller: all processes participate in the host gather (a
@@ -140,7 +140,8 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
     ``retries``/``retry_backoff_s`` retry transient ``OSError`` writes
     with exponential backoff; ``keep`` prunes to the newest N generations
     after a successful write. ``faults`` threads the injection plane
-    through to the write hook."""
+    through to the write hook; ``journal`` (obs/events.py) records each
+    durable generation as a ``checkpoint/written`` event."""
     os.makedirs(directory, exist_ok=True)
     path = _ckpt_path(directory, step)
     to_save = _host_gather(_unwrap_keys(state))
@@ -158,6 +159,7 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
                     retry_backoff_s=retry_backoff_s, manifest=manifest,
                     faults=faults)
                 _prune_old(directory, keep)
+                _journal_written(journal, step, path, manifest)
         finally:
             from jax.experimental import multihost_utils
 
@@ -170,6 +172,7 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
                 ckptr = ocp.PyTreeCheckpointer()
                 ckptr.save(os.path.abspath(path), to_save, force=True)
                 _prune_old(directory, keep)
+                _journal_written(journal, step, path, manifest)
                 return path
             except Exception:
                 pass
@@ -177,7 +180,21 @@ def save_checkpoint(directory: str, state: Any, step: int, *,
                         retry_backoff_s=retry_backoff_s, manifest=manifest,
                         faults=faults)
     _prune_old(directory, keep)
+    _journal_written(journal, step, path, manifest)
     return path
+
+
+def _journal_written(journal, step: int, path: str,
+                     manifest: bool) -> None:
+    """Record a durable generation in the control-plane journal (no-op
+    when journaling is off; never raises into the save path)."""
+    if journal is None:
+        return
+    try:
+        journal.emit("checkpoint/written", int(step),
+                     detail={"path": path, "manifest": bool(manifest)})
+    except Exception:
+        pass
 
 
 def _sweep_stale_tmps(directory: str, min_age_secs: float = 300.0) -> None:
@@ -409,7 +426,7 @@ def save_checkpoint_async(directory: str, state: Any, step: int, *,
                           keep: int = 0, retries: int = 0,
                           retry_backoff_s: float = 0.25,
                           manifest: bool = False, faults=None,
-                          failure_cb=None):
+                          journal=None, failure_cb=None):
     """Non-blocking save: the device→host fetch happens synchronously (it
     must — the caller's next train step donates/overwrites the state
     buffers), then serialization + file IO run on a background thread so
@@ -426,7 +443,7 @@ def save_checkpoint_async(directory: str, state: Any, step: int, *,
     if jax.process_count() > 1:
         save_checkpoint(directory, state, step, keep=keep, retries=retries,
                         retry_backoff_s=retry_backoff_s, manifest=manifest,
-                        faults=faults)
+                        faults=faults, journal=journal)
         return None
     os.makedirs(directory, exist_ok=True)
     path = _ckpt_path(directory, step)
@@ -437,6 +454,9 @@ def save_checkpoint_async(directory: str, state: Any, step: int, *,
                             retry_backoff_s=retry_backoff_s,
                             manifest=manifest, faults=faults)
         _prune_old(directory, keep)
+        # Journaled on the writer thread — emit() is thread-safe and the
+        # event marks when the generation actually became durable.
+        _journal_written(journal, step, path, manifest)
 
     return _AsyncSave(write, name=f"ckpt-write-{step}",
                       failure_cb=failure_cb)
@@ -462,7 +482,8 @@ def latest_step(directory: str) -> Optional[int]:
 
 def restore_checkpoint(directory: str, template: Any,
                        step: Optional[int] = None, *,
-                       verify: bool = True) -> Tuple[Any, int]:
+                       verify: bool = True,
+                       journal=None) -> Tuple[Any, int]:
     """Restore the checkpoint at ``step`` (default: latest) into the
     structure of ``template`` (a live state used for pytree/shape/dtype
     reference). Returns ``(state, step)``.
@@ -490,7 +511,8 @@ def restore_checkpoint(directory: str, template: Any,
     that still deserializes) is caught and falls back exactly like a torn
     file. Checkpoints without a sidecar restore unverified (back-compat)."""
     if step is not None:
-        return _restore_one(directory, template, step, verify=verify), step
+        return _restore_one(directory, template, step, verify=verify,
+                            journal=journal), step
     _sweep_stale_tmps(directory)
     steps = all_steps(directory)
     multi = jax.process_count() > 1
@@ -528,7 +550,7 @@ def restore_checkpoint(directory: str, template: Any,
     for candidate in reversed(steps):
         try:
             restored = _restore_one(directory, template, candidate,
-                                    verify=verify)
+                                    verify=verify, journal=journal)
             local_ok, err = True, None
         except Exception as e:  # corrupt/partial file — try older
             restored, local_ok, err = None, False, e
@@ -540,11 +562,20 @@ def restore_checkpoint(directory: str, template: Any,
                 f"warning: checkpoint ckpt_{candidate} in {directory} failed "
                 f"to restore ({type(err).__name__}: {err}); trying older"
             )
-        elif multi:
+            reason = f"{type(err).__name__}: {err}"
+        else:
             print(
                 f"warning: checkpoint ckpt_{candidate} restored locally but "
                 f"failed on a peer process; trying older"
             )
+            reason = "peer process failed to restore it"
+        if journal is not None:
+            try:
+                journal.emit("checkpoint/fallback", int(candidate),
+                             detail={"rejected_step": int(candidate),
+                                     "reason": reason})
+            except Exception:
+                pass
     raise RuntimeError(
         f"all {len(steps)} checkpoints under {directory} failed to restore"
         + (f"; newest local error: {errors[0][1]!r}" if errors else
@@ -567,7 +598,7 @@ def _load_manifest(path: str) -> Optional[Dict[str, Any]]:
 
 
 def _restore_one(directory: str, template: Any, step: int,
-                 verify: bool = True) -> Any:
+                 verify: bool = True, journal=None) -> Any:
     path = _ckpt_path(directory, step)
     # Only the template's structure/shapes/dtypes matter (the deserializer
     # overwrites every value) — build host zeros rather than fetching (or,
@@ -614,6 +645,14 @@ def _restore_one(directory: str, template: Any, step: int,
                     raise ValueError(
                         f"ckpt_{step} leaf {key!r} sha256 mismatch "
                         "(corrupt value survived deserialization)")
+        if doc is not None and journal is not None:
+            try:
+                journal.emit(
+                    "checkpoint/verified", int(step),
+                    detail={"path": path,
+                            "leaves": len(doc.get("leaves") or {})})
+            except Exception:
+                pass
     # Pull everything to host first — orbax otherwise hands back arrays
     # committed to device 0 with layouts of ITS choosing, which conflicts
     # with a multi-device mesh.

@@ -316,6 +316,25 @@ def elastic_restore(directory: str, trainer,
     w_old = int(np.shape(old.ema.value)[0])
     w_new = int(np.shape(template.ema.value)[0])
 
+    # Journal the reshard as a begin/end pair (host-side only): the
+    # (W, L) change is the single most important fact for explaining a
+    # post-resume trajectory shift.
+    journal = getattr(trainer, "_journal", None)
+    begin_eid = None
+    if journal is not None:
+        tab_old = getattr(old, "scoretable", None)
+        tab_new = getattr(template, "scoretable", None)
+        # Shape metadata only — never materializes device values.
+        l_old = (int(np.shape(tab_old.scores)[1])
+                 if tab_old is not None else None)
+        l_new = (int(np.shape(tab_new.scores)[1])
+                 if tab_new is not None else None)
+        begin_eid = journal.emit(
+            "elastic/reshard_begin", restored_step,
+            detail={"w_old": w_old, "w_new": w_new,
+                    "l_old": l_old, "l_new": l_new,
+                    "directory": directory})
+
     params = _check_same(old.params, ckpt._unwrap_keys(template).params,
                          "params")
     batch_stats = _check_same(old.batch_stats, template.batch_stats,
@@ -365,6 +384,10 @@ def elastic_restore(directory: str, trainer,
         # re-primes pending_sel in Trainer.restore_elastic).
         **extra,
     )
+    if journal is not None:
+        journal.emit("elastic/reshard_end", restored_step,
+                     parent=begin_eid,
+                     detail={"w_old": w_old, "w_new": w_new})
     # Re-placement (global arrays multi-controller, committed TP layout)
     # is the caller's job — Trainer.restore_elastic runs the same
     # _recommit_state step the plain restore path uses.

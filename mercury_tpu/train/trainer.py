@@ -31,6 +31,7 @@ Parity map:
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 from typing import Any, Dict, List, Optional
@@ -114,6 +115,11 @@ class Trainer:
         mesh=None,
     ) -> None:
         self.config = config
+        if config.serve_port < 0 or config.serve_port > 65535:
+            raise ValueError(
+                f"serve_port must be 0 (off) or a valid TCP port, "
+                f"got {config.serve_port}"
+            )
         self.dataset = dataset if dataset is not None else build_dataset(config)
         tp = config.tensor_parallel
         fs = config.fsdp_parallel
@@ -504,11 +510,25 @@ class Trainer:
         # pipeline, scorer fleet, checkpoint writes, the fit loop). None
         # when disabled — each hook site is a plain attribute check and
         # the traced step program is byte-identical (Layer-2/3 digests).
+        # --- control-plane event journal (obs/events.py): every host
+        # appends its supervisor/scorer/fault/elastic/checkpoint/anomaly
+        # decisions to events.h{p}.jsonl with causal parent_id links.
+        # Built FIRST among the host-side subsystems so every producer
+        # below can take it at construction. Emission is a buffered dict
+        # append; IO rides the metric writer's drain thread. Host-only —
+        # the traced program is byte-identical with it on or off.
+        self._journal = None
+        if config.log_dir and config.event_journal:
+            from mercury_tpu.obs.events import EventJournal
+
+            self._journal = EventJournal(config.log_dir,
+                                         jax.process_index())
         self._faults = None
         if config.fault_spec:
             from mercury_tpu.faults import FaultPlane
 
-            self._faults = FaultPlane(config.fault_spec)
+            self._faults = FaultPlane(config.fault_spec,
+                                      journal=self._journal)
         # --- observability: run manifest + non-blocking metric stream ---
         # The manifest (resolved config, jax/jaxlib versions, mesh/device
         # topology, git sha) makes the metrics stream interpretable later;
@@ -593,6 +613,7 @@ class Trainer:
                 tracer=self.tracer,
                 context_fn=self._flight_context,
                 profile_steps=config.anomaly_profile_steps,
+                journal=self._journal,
             )
         # --- sampler-health monitor (obs/sampler_health.py): derives the
         # coverage / Gini / class-spread / bias-audit scalars from the
@@ -622,7 +643,8 @@ class Trainer:
         if self.anomaly is not None:
             observers.append(self.anomaly.observe_record)
         self.logger = AsyncMetricWriter(sinks, observers=observers,
-                                        faults=self._faults)
+                                        faults=self._faults,
+                                        journal=self._journal)
         # --- host supervisor (runtime/supervisor.py): liveness + restart
         # + the degradation ladder. Units register below as the worker
         # fleets are built; the writer-observer hook makes the supervisor
@@ -641,6 +663,7 @@ class Trainer:
                 probe_every=config.supervisor_probe_every,
                 poll_s=config.supervisor_poll_s,
                 anomaly=self.anomaly,
+                journal=self._journal,
             )
             self.logger.add_observer(self.supervisor.observe_record)
         # On-demand jax.profiler capture window: >0 means "this many more
@@ -807,6 +830,7 @@ class Trainer:
                     tracer=self.tracer,
                     faults=self._faults,
                     train_mesh=self.mesh,
+                    journal=self._journal,
                 )
             else:
                 from mercury_tpu.sampling.scorer_fleet import ScorerFleet
@@ -895,6 +919,57 @@ class Trainer:
                     _log.info("auto-resumed from checkpoint at step %d",
                               resumed)
                 self._auto_resumed = True
+
+        # --- live scrape plane (obs/serve.py): /healthz /statusz
+        # /metricsz on host 0, started LAST so every callback target
+        # exists. serve_port=0 (default) means no server object, no
+        # thread, no socket — the disabled path costs nothing.
+        self._status_server = None
+        if config.serve_port > 0 and pidx == 0:
+            from mercury_tpu.obs.serve import StatusServer
+
+            self._status_server = StatusServer(
+                config.serve_port,
+                health_fn=self._serve_health,
+                status_fn=self._serve_status,
+                metrics_fn=self.logger.latest_record,
+            )
+
+    # ---------------------------------------------------------- scrape plane
+    def _serve_health(self) -> Dict[str, Any]:
+        """``/healthz`` body: liveness + ladder level. Runs on the serve
+        thread — host counters only, never a device sync."""
+        body: Dict[str, Any] = {"step": self._host_step}
+        if self.supervisor is not None:
+            s = self.supervisor.summary()
+            body["level"] = s["level"]
+            body["level_name"] = s["level_name"]
+            body["units_down"] = sum(1 for u in s["units"] if u["down"])
+        return body
+
+    def _serve_status(self) -> Dict[str, Any]:
+        """``/statusz`` body: manifest + ladder + tenant queues + the
+        journal tail — the first page of any live incident."""
+        doc: Dict[str, Any] = {"step": self._host_step}
+        if self.config.log_dir:
+            try:
+                with open(os.path.join(self.config.log_dir,
+                                       "run_manifest.json")) as f:
+                    doc["manifest"] = json.load(f)
+            except Exception:
+                pass
+        if self.supervisor is not None:
+            doc["supervisor"] = self.supervisor.summary()
+        fleet = getattr(self, "_scorer_fleet", None)
+        if fleet is not None and hasattr(fleet, "summary"):
+            try:
+                doc["scorer"] = fleet.summary()
+            except Exception:
+                pass
+        if self._journal is not None:
+            doc["events"] = self._journal.tail()
+            doc["event_counts"] = self._journal.counts()
+        return doc
 
     # -------------------------------------------------------- host streaming
     def _stream_emit_size(self) -> int:
@@ -1484,6 +1559,7 @@ class Trainer:
             retry_backoff_s=cfg.checkpoint_retry_backoff_s,
             manifest=cfg.checkpoint_manifest,
             faults=self._faults,
+            journal=self._journal,
         )
 
     def _ckpt_failure_cb(self, exc: BaseException) -> None:
@@ -1581,33 +1657,84 @@ class Trainer:
         if getattr(self, "_closed", False):
             return
         self._closed = True
+        try:
+            server = getattr(self, "_status_server", None)
+            if server is not None:
+                # Scrapers go first: a request arriving mid-teardown
+                # would read half-closed subsystems.
+                server.close()
+            supervisor = getattr(self, "supervisor", None)
+            if supervisor is not None:
+                # A live supervisor poll/probe must not race the unit
+                # teardown below (it would read restarts as deaths).
+                supervisor.close()
+            fleet = getattr(self, "_scorer_fleet", None)
+            if fleet is not None:
+                fleet.close()
+            monitor = getattr(self, "_retrace_monitor", None)
+            if monitor is not None:
+                monitor.stop()
+            if getattr(self, "_stream_pipe", None) is not None:
+                self._stream_pipe.close()
+            if getattr(self, "_profiling", False):
+                self._stop_profiler()
+            tracer = getattr(self, "tracer", None)
+            config = getattr(self, "config", None)
+            journal = getattr(self, "_journal", None)
+            if (tracer is not None and tracer.enabled
+                    and config is not None and config.log_dir
+                    and jax.process_index() == 0):
+                try:
+                    # Merge the control-plane journal into the exported
+                    # timeline: spans + decision instants + causal flow
+                    # arrows land in ONE perfetto-loadable trace.json.
+                    events = []
+                    if journal is not None:
+                        from mercury_tpu.obs.events import (
+                            journal_filename,
+                            read_journal,
+                        )
+
+                        journal.flush()
+                        events = read_journal(os.path.join(
+                            config.log_dir,
+                            journal_filename(jax.process_index())))
+                    tracer.export_chrome_trace(
+                        os.path.join(config.log_dir, "trace.json"),
+                        events=events or None)
+                except Exception as exc:
+                    _log.warning("trace export failed: %s", exc)
+            logger = getattr(self, "logger", None)
+            if logger is not None:
+                logger.close()
+        finally:
+            # Even a teardown crash leaves the ladder history and the
+            # journal on disk — they are the post-mortem.
+            self._write_supervisor_summary()
+            journal = getattr(self, "_journal", None)
+            if journal is not None:
+                journal.close()
+
+    def _write_supervisor_summary(self) -> None:
+        """Persist ``HostSupervisor.summary()`` (ladder transitions,
+        restart budgets, SLO latch counts) as ``supervisor_summary.json``
+        — called from ``close()``'s finally so a crashed run still
+        leaves its ladder history on disk. Never raises."""
         supervisor = getattr(self, "supervisor", None)
-        if supervisor is not None:
-            # First: a live supervisor poll/probe must not race the unit
-            # teardown below (it would read restarts as deaths).
-            supervisor.close()
-        fleet = getattr(self, "_scorer_fleet", None)
-        if fleet is not None:
-            fleet.close()
-        monitor = getattr(self, "_retrace_monitor", None)
-        if monitor is not None:
-            monitor.stop()
-        if getattr(self, "_stream_pipe", None) is not None:
-            self._stream_pipe.close()
-        if getattr(self, "_profiling", False):
-            self._stop_profiler()
-        tracer = getattr(self, "tracer", None)
         config = getattr(self, "config", None)
-        if (tracer is not None and tracer.enabled and config is not None
-                and config.log_dir and jax.process_index() == 0):
-            try:
-                tracer.export_chrome_trace(
-                    os.path.join(config.log_dir, "trace.json"))
-            except Exception as exc:
-                _log.warning("trace export failed: %s", exc)
-        logger = getattr(self, "logger", None)
-        if logger is not None:
-            logger.close()
+        if (supervisor is None or config is None or not config.log_dir
+                or jax.process_index() != 0):
+            return
+        try:
+            path = os.path.join(config.log_dir,
+                                "supervisor_summary.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(supervisor.summary(), f, indent=2, default=str)
+                f.write("\n")
+            os.replace(tmp, path)
+        except Exception as exc:
+            _log.warning("supervisor summary write failed: %s", exc)
 
     def __enter__(self) -> "Trainer":
         return self
@@ -1864,6 +1991,7 @@ class Trainer:
         assert directory, "no checkpoint directory configured"
         self.state, step = ckpt.restore_checkpoint(
             directory, self.state, step,
-            verify=self.config.checkpoint_verify)
+            verify=self.config.checkpoint_verify,
+            journal=self._journal)
         self._recommit_state()
         return step

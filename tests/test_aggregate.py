@@ -149,6 +149,41 @@ class TestHostShardAggregator:
         merged = agg.poll()
         assert merged["host/max/step_time_s"] == pytest.approx(0.25)
 
+    def test_rotation_shrink_resets_tail_offset(self, tmp_path):
+        # Size-capped rotation (HeartbeatShardSink) replaces a shard
+        # with a fresh, smaller file: the byte-offset tailer must detect
+        # the shrink, restart from offset 0, and keep merging — not
+        # wedge on a stale offset past EOF.
+        path = write_shard(tmp_path, 0, [shard_record(1, 0.10),
+                                         shard_record(2, 0.12)])
+        agg = HostShardAggregator(str(tmp_path), processes=1)
+        assert agg.poll()["host/max/step_time_s"] == pytest.approx(0.12)
+        assert agg._offsets[path] > 0
+        with open(path, "w") as f:  # rotated: fresh shard, new rows
+            f.write(json.dumps(shard_record(3, 0.30)) + "\n")
+        merged = agg.poll()
+        assert agg.errors == 0
+        assert merged["host/max/step_time_s"] == pytest.approx(0.30)
+        assert agg._offsets[path] == os.path.getsize(path)
+
+    def test_rotation_shrink_drops_buffered_partial(self, tmp_path):
+        # A torn line buffered from the PRE-rotation file must not be
+        # glued onto post-rotation content — its tail never arrives.
+        path = os.path.join(str(tmp_path), shard_filename(0))
+        rows = "".join(json.dumps(shard_record(s, 0.25)) + "\n"
+                       for s in range(1, 9))
+        with open(path, "w") as f:
+            f.write(rows[:-10])  # at cap, torn mid-final-row
+        agg = HostShardAggregator(str(tmp_path), processes=1)
+        assert agg.poll()["host/max/step_time_s"] == pytest.approx(0.25)
+        assert agg._partial  # the torn fragment is buffered
+        with open(path, "w") as f:  # rotation: smaller fresh file
+            f.write(json.dumps(shard_record(5, 0.50)) + "\n")
+        merged = agg.poll()
+        assert agg.errors == 0
+        assert merged["host/max/step_time_s"] == pytest.approx(0.50)
+        assert not agg._partial
+
     def test_late_appearing_shard_joins(self, tmp_path):
         write_shard(tmp_path, 0, [shard_record(1, 0.1)])
         agg = HostShardAggregator(str(tmp_path), processes=2)

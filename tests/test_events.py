@@ -1,0 +1,393 @@
+"""Control-plane black box: the causal event journal (``obs/events.py``),
+the live scrape plane (``obs/serve.py``), the journal→Chrome-trace merge
+(``obs/trace.py``), and the report's "Run timeline" section.
+
+Everything here is jax-free by design — the journal and its consumers
+are stdlib-only so post-mortems and CI validators run anywhere. The
+producer-integration half (supervisor/fault/anomaly call sites emitting
+during a real fit) lives in tests/test_supervisor.py and the CI chaos
+smoke; this file pins the contracts those integrations rely on.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mercury_tpu.obs.events import (
+    DEFAULT_CAPACITY,
+    EVENT_SCHEMA,
+    EventJournal,
+    journal_filename,
+    load_events,
+    parent_chain,
+    read_journal,
+    validate_event,
+)
+from mercury_tpu.obs.registry import EVENT_KINDS
+from mercury_tpu.obs.serve import (
+    OPENMETRICS_CONTENT_TYPE,
+    StatusServer,
+    metric_name,
+    parse_openmetrics,
+    render_openmetrics,
+)
+from mercury_tpu.obs.trace import (
+    journal_lane_events,
+    merge_events_into_trace,
+)
+
+
+class TestEventJournal:
+    def test_emit_flush_read_roundtrip(self, tmp_path):
+        j = EventJournal(str(tmp_path), 0)
+        root = j.emit("fault/fired", 3, detail={"fault": "scorer_die"})
+        child = j.emit("supervisor/degrade", 3, parent=root,
+                       detail={"to": "sync"})
+        assert root == "e0-0" and child == "e0-1"
+        # emit buffers — nothing but the header is durable yet.
+        assert read_journal(j.path) == []
+        assert j.flush() == 2
+        j.close()
+        events = read_journal(j.path)
+        assert [e["event_id"] for e in events] == [root, child]
+        assert events[1]["parent_id"] == root
+        assert events[1]["detail"] == {"to": "sync"}
+        for evt in events:
+            assert validate_event(evt, registry=EVENT_KINDS) == []
+        # The header line carries the schema tag and is skipped by the
+        # reader.
+        first = open(j.path).readline()
+        assert json.loads(first)["schema"] == EVENT_SCHEMA
+
+    def test_emit_after_close_is_dropped(self, tmp_path):
+        j = EventJournal(str(tmp_path), 0)
+        j.close()
+        assert j.emit("fault/fired", 1) is None
+
+    def test_capacity_drops_oldest(self, tmp_path):
+        j = EventJournal(str(tmp_path), 0, capacity=4)
+        for i in range(7):
+            j.emit("fault/fired", i)
+        assert j.counts() == {"emitted": 7, "dropped": 3, "buffered": 4}
+        j.close()
+        steps = [e["step"] for e in read_journal(j.path)]
+        assert steps == [3, 4, 5, 6]  # oldest three gone
+        assert DEFAULT_CAPACITY >= 1024  # runaway guard, not a tuning knob
+
+    def test_tail_survives_flush(self, tmp_path):
+        j = EventJournal(str(tmp_path), 0)
+        for i in range(5):
+            j.emit("fault/fired", i)
+        j.flush()
+        j.emit("supervisor/degrade", 5, detail={"to": "sync"})
+        tail = j.tail(3)
+        assert [e["step"] for e in tail] == [3, 4, 5]
+        assert tail[-1]["kind"] == "supervisor/degrade"
+        assert j.tail(0) == []
+        j.close()
+
+    def test_torn_final_line_tolerated(self, tmp_path):
+        j = EventJournal(str(tmp_path), 0)
+        j.emit("fault/fired", 1)
+        j.emit("fault/fired", 2)
+        j.close()
+        with open(j.path, "a") as f:
+            f.write('{"event_id": "e0-torn", "ki')  # crash mid-append
+        events = read_journal(j.path)
+        assert [e["step"] for e in events] == [1, 2]
+
+    def test_unserializable_detail_degrades(self, tmp_path):
+        j = EventJournal(str(tmp_path), 0)
+        j.emit("fault/fired", 1, detail={"obj": threading.Lock()})
+        j.close()
+        (evt,) = read_journal(j.path)
+        assert isinstance(evt["detail"], dict)  # degraded, not raised
+
+    def test_load_events_merges_shards_by_wall_clock(self, tmp_path):
+        j0 = EventJournal(str(tmp_path), 0)
+        j1 = EventJournal(str(tmp_path), 1)
+        j0.emit("fault/fired", 1)
+        j1.emit("fault/fired", 2)
+        j0.emit("fault/fired", 3)
+        j0.close()
+        j1.close()
+        assert journal_filename(1) == "events.h1.jsonl"
+        merged = load_events(str(tmp_path))
+        assert len(merged) == 3
+        assert {e["host"] for e in merged} == {0, 1}
+        walls = [e["wall_s"] for e in merged]
+        assert walls == sorted(walls)
+
+    def test_concurrent_emitters_keep_ids_unique(self, tmp_path):
+        j = EventJournal(str(tmp_path), 0)
+
+        def emitter(n):
+            for i in range(200):
+                j.emit("fault/fired", i, detail={"t": n})
+
+        threads = [threading.Thread(target=emitter, args=(n,))
+                   for n in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        j.close()
+        events = read_journal(j.path)
+        assert len(events) == 800
+        assert len({e["event_id"] for e in events}) == 800
+
+
+class TestValidateAndChains:
+    def test_validate_event_rejects_bad_rows(self):
+        good = {"event_id": "e0-0", "parent_id": None,
+                "kind": "fault/fired", "step": 1, "mono_ns": 1,
+                "wall_s": 1.0, "host": 0, "detail": {}}
+        assert validate_event(good) == []
+        assert validate_event("nope") == ["event is not an object"]
+        assert validate_event({}) != []
+        bad = dict(good, kind="no_slash")
+        assert any("subsystem/name" in p for p in validate_event(bad))
+        unreg = dict(good, kind="bogus/kind")
+        assert validate_event(unreg) == []  # shape-valid without registry
+        assert any("EVENT_KINDS" in p
+                   for p in validate_event(unreg, registry=EVENT_KINDS))
+
+    def test_parent_chain_reconstructs_ladder_walk(self, tmp_path):
+        # The acceptance shape: exhausted → degrade(sync) → probe_failed
+        # → degrade(frozen) — reconstructable root-first from the leaf.
+        j = EventJournal(str(tmp_path), 0)
+        e0 = j.emit("supervisor/exhausted", 2)
+        e1 = j.emit("supervisor/degrade", 2, parent=e0,
+                    detail={"to": "sync"})
+        e2 = j.emit("supervisor/probe_failed", 3, parent=e1)
+        e3 = j.emit("supervisor/degrade", 3, parent=e2,
+                    detail={"to": "frozen"})
+        j.close()
+        events = read_journal(j.path)
+        chain = parent_chain(events, e3)
+        assert [e["event_id"] for e in chain] == [e0, e1, e2, e3]
+        assert [e["kind"] for e in chain] == [
+            "supervisor/exhausted", "supervisor/degrade",
+            "supervisor/probe_failed", "supervisor/degrade"]
+
+    def test_parent_chain_terminates_on_cycle(self):
+        events = [
+            {"event_id": "a", "parent_id": "b", "kind": "x/y"},
+            {"event_id": "b", "parent_id": "a", "kind": "x/y"},
+        ]
+        chain = parent_chain(events, "a")
+        assert len(chain) == 2  # no infinite loop
+
+
+class TestTraceMerge:
+    def events(self):
+        return [
+            {"event_id": "e0-0", "parent_id": None, "kind": "fault/fired",
+             "step": 1, "mono_ns": 1, "wall_s": 100.5, "host": 0,
+             "detail": {"fault": "scorer_die"}},
+            {"event_id": "e0-1", "parent_id": "e0-0",
+             "kind": "supervisor/degrade", "step": 1, "mono_ns": 2,
+             "wall_s": 100.7, "host": 0, "detail": {"to": "sync"}},
+        ]
+
+    def test_journal_lane_events_shape(self):
+        out = journal_lane_events(self.events(), epoch_unix_s=100.0,
+                                  pid=7)
+        instants = [e for e in out if e.get("ph") == "i"]
+        assert [e["name"] for e in instants] == [
+            "fault/fired", "supervisor/degrade"]
+        # One synthetic lane per subsystem, named for Perfetto.
+        names = {e["args"]["name"] for e in out
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+        assert {"events/fault", "events/supervisor"} <= names
+        assert {e["tid"] for e in instants} != {instants[0]["tid"]} or \
+            len({e["tid"] for e in instants}) == 2
+        # Timebase: wall_s aligned onto the tracer epoch in µs.
+        assert instants[0]["ts"] == pytest.approx(0.5e6)
+        # parent link → one flow start + one flow finish, same id.
+        flows = [e for e in out if e.get("ph") in ("s", "f")]
+        assert len(flows) == 2
+        assert flows[0]["id"] == flows[1]["id"]
+
+    def test_merge_events_into_trace_offline(self):
+        doc = {"traceEvents": [{"name": "trainer/dispatch", "ph": "X",
+                                "ts": 0.0, "dur": 5.0, "pid": 1,
+                                "tid": 2}],
+               "otherData": {"epoch_unix_s": 100.0}}
+        merged = merge_events_into_trace(doc, self.events())
+        assert merged["otherData"]["journal_events"] == 2
+        cats = {e.get("cat") for e in merged["traceEvents"]}
+        assert "events" in cats
+        # The original span survives untouched.
+        assert merged["traceEvents"][0]["name"] == "trainer/dispatch"
+
+
+class TestOpenMetrics:
+    def test_metric_name_charset(self):
+        assert metric_name("train/loss") == "mercury_train_loss"
+        assert metric_name("host/spread/step_time_s") == \
+            "mercury_host_spread_step_time_s"
+        assert metric_name("train/loss", prefix="") == "train_loss"
+
+    def test_render_parse_roundtrip(self):
+        record = {"train/loss": 1.5, "supervisor/level": 0.0,
+                  "perf/mfu": 0.31}
+        text = render_openmetrics(record)
+        assert text.endswith("# EOF\n")
+        parsed = parse_openmetrics(text)
+        assert parsed == {"mercury_train_loss": 1.5,
+                          "mercury_supervisor_level": 0.0,
+                          "mercury_perf_mfu": 0.31}
+
+    def test_empty_record_is_valid_exposition(self):
+        for record in (None, {}):
+            assert parse_openmetrics(render_openmetrics(record)) == {}
+
+    def test_non_numeric_values_skipped(self):
+        text = render_openmetrics({"train/loss": 2.0, "obs/note": "hi"})
+        assert parse_openmetrics(text) == {"mercury_train_loss": 2.0}
+
+    def test_parser_rejects_malformed(self):
+        with pytest.raises(ValueError, match="EOF"):
+            parse_openmetrics("mercury_x 1.0\n")
+        with pytest.raises(ValueError, match="sample"):
+            parse_openmetrics("!bad line!\n# EOF\n")
+        with pytest.raises(ValueError, match="after"):
+            parse_openmetrics("# EOF\nmercury_x 1.0\n")
+        with pytest.raises(ValueError, match="metadata"):
+            parse_openmetrics("# NONSENSE\n# EOF\n")
+
+
+class TestStatusServer:
+    def get(self, port, path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.status, r.headers.get("Content-Type"), \
+                    r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get("Content-Type"), \
+                e.read().decode()
+
+    def test_endpoints_and_close(self):
+        state = {"level": 0}
+        with StatusServer(
+                0,
+                health_fn=lambda: {"level": state["level"], "step": 12},
+                status_fn=lambda: {"step": 12, "events": {"tail": []}},
+                metrics_fn=lambda: {"train/loss": 1.25}) as srv:
+            assert srv.port > 0  # ephemeral bind
+            status, ctype, body = self.get(srv.port, "/healthz")
+            assert status == 200 and json.loads(body)["healthy"]
+            status, ctype, body = self.get(srv.port, "/statusz")
+            assert status == 200
+            assert json.loads(body)["step"] == 12
+            status, ctype, body = self.get(srv.port, "/metricsz")
+            assert status == 200
+            assert ctype == OPENMETRICS_CONTENT_TYPE
+            assert parse_openmetrics(body) == {"mercury_train_loss": 1.25}
+            status, _, body = self.get(srv.port, "/nope")
+            assert status == 404
+            assert "/healthz" in body
+            # Degrade → the same prober now sees 503.
+            state["level"] = 2
+            status, _, body = self.get(srv.port, "/healthz")
+            assert status == 503
+            assert json.loads(body)["healthy"] is False
+        srv.close()  # idempotent after __exit__
+
+    def test_callback_failure_is_503_not_crash(self):
+        def boom():
+            raise RuntimeError("supervisor gone")
+
+        with StatusServer(0, health_fn=boom) as srv:
+            status, _, body = self.get(srv.port, "/healthz")
+            assert status == 503
+            assert "supervisor gone" in body
+            # The accept thread survived; another scrape still answers.
+            status, _, _ = self.get(srv.port, "/metricsz")
+            assert status == 200
+
+    def test_accept_thread_named_and_joined(self):
+        before = {t.name for t in threading.enumerate()}
+        srv = StatusServer(0)
+        assert "mercury-serve" in {t.name for t in threading.enumerate()}
+        srv.close()
+        after = [t for t in threading.enumerate()
+                 if t.name == "mercury-serve"]
+        assert not after, "accept thread leaked past close()"
+        assert before  # unchanged set not required — daemon pool varies
+
+    @pytest.mark.parametrize("port", [-2, 70000])
+    def test_trainer_rejects_invalid_serve_port(self, port):
+        # A typo'd port must fail fast at construction, not silently
+        # disable the scrape plane (0 is the only "off" spelling).
+        from mercury_tpu.config import TrainConfig
+        from mercury_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(model="smallcnn", dataset="synthetic",
+                          world_size=1, serve_port=port)
+        with pytest.raises(ValueError, match="serve_port"):
+            Trainer(cfg)
+
+
+class TestReportTimeline:
+    def run_dir(self, tmp_path):
+        j = EventJournal(str(tmp_path), 0)
+        e0 = j.emit("supervisor/exhausted", 2)
+        e1 = j.emit("supervisor/degrade", 2, parent=e0,
+                    detail={"to": "sync"})
+        e2 = j.emit("supervisor/probe_failed", 3, parent=e1)
+        j.emit("supervisor/degrade", 3, parent=e2,
+               detail={"to": "frozen"})
+        j.emit("fault/fired", 1, detail={"fault": "scorer_die"})
+        j.close()
+        with open(os.path.join(str(tmp_path), "metrics.jsonl"), "w") as f:
+            f.write(json.dumps({"step": 1, "train/loss": 2.0}) + "\n")
+        with open(os.path.join(str(tmp_path),
+                               "supervisor_summary.json"), "w") as f:
+            json.dump({"level": 2, "level_name": "frozen", "restarts": 0,
+                       "degradations": 2, "recoveries": 0,
+                       "transitions": [{"step": 2, "from": "async",
+                                        "to": "sync", "reason": "x"}]},
+                      f)
+        return str(tmp_path)
+
+    def test_markdown_renders_causal_walk(self, tmp_path):
+        from mercury_tpu.obs import report
+
+        run = report.load_run(self.run_dir(tmp_path))
+        assert len(run["events"]) == 5
+        text = report.render_markdown(report._run_blocks(run))
+        assert "Run timeline" in text
+        assert "Degrade episodes" in text
+        # The longest chain per episode renders as one arrow walk.
+        assert ("supervisor/exhausted@2 → supervisor/degrade[sync]@2 → "
+                "supervisor/probe_failed@3 → "
+                "supervisor/degrade[frozen]@3") in text
+        assert "fault/fired" in text  # census covers unlinked roots
+        assert "Supervisor summary" in text
+        assert "frozen" in text
+
+    def test_html_renders_timeline(self, tmp_path):
+        from mercury_tpu.obs import report
+
+        run = report.load_run(self.run_dir(tmp_path))
+        html = report.render_html(report._run_blocks(run))
+        assert "Run timeline" in html
+        assert "Degrade episodes" in html
+
+    def test_runs_without_journal_render_no_timeline(self, tmp_path):
+        from mercury_tpu.obs import report
+
+        with open(os.path.join(str(tmp_path), "metrics.jsonl"),
+                  "w") as f:
+            f.write(json.dumps({"step": 1, "train/loss": 2.0}) + "\n")
+        run = report.load_run(str(tmp_path))
+        assert run["events"] == []
+        text = report.render_markdown(report._run_blocks(run))
+        assert "Run timeline" not in text

@@ -172,6 +172,132 @@ class TestLayerM:
             assert "GLM03" in w
 
 
+class TestGLM04EventKinds:
+    """Event-kind parity (GLM04): journal-emit first arguments vs
+    ``EVENT_KINDS`` vs the OBSERVABILITY.md kind catalog — and the
+    plane separation that keeps event kinds out of the metric scan."""
+
+    REGISTRY = ('METRIC_KEYS = {"train/loss": "l"}\n'
+                'EVENT_KINDS = {"supervisor/degrade": "descent"}\n')
+
+    def tree(self, tmp_path, src, registry=None, event_docs=None):
+        paths, reg, doc = write_tree(
+            tmp_path, package={"a.py": src},
+            registry=registry if registry is not None else self.REGISTRY,
+            docs="`train/loss`\n")
+        edoc = tmp_path / "OBSERVABILITY.md"
+        edoc.write_text(event_docs if event_docs is not None
+                        else "`supervisor/degrade` — one descent\n")
+        return paths, reg, doc, str(edoc)
+
+    def test_clean_quad_passes(self, tmp_path):
+        paths, reg, doc, edoc = self.tree(
+            tmp_path,
+            'KEY = "train/loss"\n'
+            'self._journal.emit("supervisor/degrade", 3)\n')
+        errors, warnings = run_metrics_check(paths, reg, doc, edoc)
+        assert errors == []
+        assert warnings == []
+
+    def test_unregistered_emit_is_error(self, tmp_path):
+        paths, reg, doc, edoc = self.tree(
+            tmp_path,
+            'self._journal.emit("supervisor/degrade", 1)\n'
+            'journal.emit("supervisor/typo_kind", 2)\n')
+        errors, _ = run_metrics_check(paths, reg, doc, edoc)
+        assert len(errors) == 1
+        assert "GLM04" in errors[0] and "supervisor/typo_kind" in errors[0]
+        assert "a.py:2" in errors[0]
+
+    def test_wrapper_emit_call_is_detected(self, tmp_path):
+        # The supervisor's call-site shape: a bound wrapper whose NAME
+        # carries the journal marker (self._journal_emit).
+        paths, reg, doc, edoc = self.tree(
+            tmp_path,
+            'KEY = "train/loss"\n'
+            'self._journal_emit("supervisor/degrade", 1)\n')
+        errors, warnings = run_metrics_check(paths, reg, doc, edoc)
+        assert errors == []
+        assert warnings == []
+
+    def test_registered_undocumented_is_error(self, tmp_path):
+        paths, reg, doc, edoc = self.tree(
+            tmp_path,
+            'self._journal.emit("supervisor/degrade", 1)\n',
+            event_docs="no backticked catalog entry here\n")
+        errors, _ = run_metrics_check(paths, reg, doc, edoc)
+        assert len(errors) == 1
+        assert "GLM04" in errors[0] and "supervisor/degrade" in errors[0]
+
+    def test_registered_never_emitted_is_warning(self, tmp_path):
+        paths, reg, doc, edoc = self.tree(
+            tmp_path, 'x = "train/loss"\n')
+        errors, warnings = run_metrics_check(paths, reg, doc, edoc)
+        assert errors == []
+        assert len(warnings) == 1
+        assert "GLM04" in warnings[0] and "never" in warnings[0]
+
+    def test_emit_args_excluded_from_metric_scan(self, tmp_path):
+        # "supervisor/degrade" shares the slash grammar with metric keys
+        # but is NOT registered in METRIC_KEYS: without the journal-emit
+        # exclusion this would be a GLM01 false positive.
+        paths, reg, doc, edoc = self.tree(
+            tmp_path, 'self._journal.emit("supervisor/degrade", 1)\n')
+        assert "supervisor/degrade" not in emitted_keys(paths)
+        errors, _ = run_metrics_check(paths, reg, doc, edoc)
+        assert errors == []
+
+    def test_kind_comparisons_excluded_from_metric_scan(self, tmp_path):
+        # Consumer side of the same plane: journal readers filter on
+        # kind (obs/report.py) — comparison literals are not emissions.
+        paths, reg, doc, edoc = self.tree(
+            tmp_path,
+            'ok = [e for e in events\n'
+            '      if e.get("kind") == "supervisor/degrade"]\n'
+            'if kind != "supervisor/degrade":\n'
+            '    pass\n')
+        assert "supervisor/degrade" not in emitted_keys(paths)
+        errors, _ = run_metrics_check(paths, reg, doc, edoc)
+        assert errors == []
+
+    def test_missing_event_registry_tolerated(self, tmp_path):
+        # A metric-only registry (no EVENT_KINDS literal) stays valid —
+        # but any journal emission against it is then unregistered.
+        paths, reg, doc, edoc = self.tree(
+            tmp_path, 'x = "train/loss"\n',
+            registry='METRIC_KEYS = {"train/loss": "l"}\n')
+        assert run_metrics_check(paths, reg, doc, edoc) == ([], [])
+        paths, reg, doc, edoc = self.tree(
+            tmp_path, 'journal.emit("supervisor/degrade", 1)\n',
+            registry='METRIC_KEYS = {"train/loss": "l"}\n')
+        errors, _ = run_metrics_check(paths, reg, doc, edoc)
+        assert len(errors) == 1 and "GLM04" in errors[0]
+
+    def test_real_event_registry_covers_producers(self):
+        # The shipped quad audits clean (the CI gate), and the kinds the
+        # acceptance chain depends on are present end to end.
+        from mercury_tpu.lint import metrics as lm
+        from mercury_tpu.lint.metrics import (
+            documented_event_kinds,
+            emitted_event_kinds,
+            load_event_registry,
+        )
+
+        kinds = load_event_registry(lm._default_registry_path())
+        emitted = emitted_event_kinds(
+            [lm._default_registry_path().rsplit("/", 2)[0]])
+        documented = documented_event_kinds(
+            lm._default_event_docs_path())
+        assert set(emitted) <= set(kinds), \
+            sorted(set(emitted) - set(kinds))
+        assert set(kinds) <= documented, \
+            sorted(set(kinds) - documented)
+        for kind in ("supervisor/degrade", "supervisor/probe_failed",
+                     "supervisor/exhausted", "fault/fired",
+                     "anomaly/triggered", "checkpoint/written"):
+            assert kind in kinds and kind in emitted, kind
+
+
 def rec(age_h=1.0, platform="tpu", mfu=0.3, **extra):
     """A bench record ``age_h`` hours old at the fixed judgment time."""
     now = calendar.timegm(time.strptime("2026-08-06T12:00:00Z",

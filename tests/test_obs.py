@@ -340,6 +340,38 @@ class TestHeartbeatShardSink:
         assert len((tmp_path / "heartbeat.h3.jsonl")
                    .read_text().splitlines()) == 1
 
+    def test_size_capped_rotation_bounds_growth(self, tmp_path):
+        # Rows are ~60 bytes; a 200-byte cap forces rotation every few
+        # writes. The live shard must stay under cap+one row, with one
+        # prior generation kept at <name>.1 — a flush-per-write sink can
+        # no longer grow without bound.
+        sink = HeartbeatShardSink(str(tmp_path), process_index=0,
+                                  max_bytes=200)
+        for step in range(40):
+            sink.write({"step": float(step), "time": 1000.0 + step})
+        sink.close()
+        live = tmp_path / "heartbeat.h0.jsonl"
+        prior = tmp_path / "heartbeat.h0.jsonl.1"
+        assert sink.rotations > 1
+        assert prior.exists()
+        assert live.stat().st_size <= 300
+        # Both generations hold intact JSON lines; the newest row is the
+        # last write (nothing lost at the rotation boundary).
+        rows = [json.loads(l) for l in
+                (prior.read_text() + live.read_text()).splitlines()]
+        assert rows[-1]["step"] == 39
+        steps = [r["step"] for r in rows]
+        assert steps == sorted(steps)
+
+    def test_max_bytes_zero_disables_rotation(self, tmp_path):
+        sink = HeartbeatShardSink(str(tmp_path), process_index=0,
+                                  max_bytes=0)
+        for step in range(50):
+            sink.write({"step": float(step)})
+        sink.close()
+        assert sink.rotations == 0
+        assert not (tmp_path / "heartbeat.h0.jsonl.1").exists()
+
 
 class TestHeartbeatSink:
     def test_rate_limited_by_step_cadence(self):

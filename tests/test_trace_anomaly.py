@@ -303,6 +303,87 @@ class TestAnomalyEngine:
             AnomalyEngine(ring_steps=0)
 
 
+class TestDebounceAcrossRestore:
+    """``restore_elastic`` resumes an earlier step with the SAME
+    per-process engine — the trainer never rebuilds or resets it. The
+    step counter runs backward once and part of the old window replays;
+    the debounce state must carry over: the replayed window cannot
+    re-dump (no re-trigger storm), ``max_dumps`` stays spent, and the
+    slow-step median ring stays armed. All counters
+    (``triggers``/``trigger_counts``/``dumps``) are per-process
+    cumulative — a restored run keeps counting where its process left
+    off, which is exactly what the flight records' tallies mean."""
+
+    def test_backward_step_replay_is_debounced(self, tmp_path):
+        eng = AnomalyEngine(ring_steps=4, cooldown_steps=100,
+                            dump_dir=str(tmp_path))
+        eng.observe_record(record(50, loss=float("nan")))
+        assert len(eng.dumps) == 1
+        # Restore to step 10: the replayed NaN fires the counter but
+        # the negative step delta sits inside the cooldown — no second
+        # dump for an episode the process already dumped.
+        eng.observe_record(record(10, loss=float("nan")))
+        assert eng.triggers == 2
+        assert len(eng.dumps) == 1
+        # The cooldown is anchored at the PRE-restore trigger step, so
+        # the engine re-arms once the replay runs past it.
+        eng.observe_record(record(155, loss=float("nan")))
+        assert len(eng.dumps) == 2
+
+    def test_debounced_replay_does_not_rearm_profiler(self, tmp_path):
+        eng = AnomalyEngine(ring_steps=4, cooldown_steps=100,
+                            profile_steps=20, dump_dir=str(tmp_path))
+        eng.observe_record(record(50, loss=float("nan")))
+        assert eng.take_profile_request() == 20
+        eng.observe_record(record(10, loss=float("nan")))  # replayed
+        assert eng.take_profile_request() == 0
+
+    def test_max_dumps_stays_spent_across_restore(self, tmp_path):
+        eng = AnomalyEngine(ring_steps=4, cooldown_steps=0, max_dumps=2,
+                            dump_dir=str(tmp_path))
+        for s in (30, 40):
+            eng.observe_record(record(s, loss=float("nan")))
+        assert len(eng.dumps) == 2
+        # Replay from step 1: the per-process dump budget does not
+        # refill on restore — a crash-restore loop cannot fill the disk.
+        for s in (1, 2, 3):
+            eng.observe_record(record(s, loss=float("nan")))
+        assert eng.triggers == 5
+        assert len(eng.dumps) == 2
+        assert len(glob.glob(str(tmp_path / "flight_record_*.json"))) == 2
+
+    def test_slow_step_ring_stays_armed_after_restore(self):
+        eng = AnomalyEngine(ring_steps=4, slow_step_factor=3.0)
+        for s in range(eng.MIN_STEP_SAMPLES):
+            eng.observe_step_time(s, 0.010)
+        # Post-restore the loop re-observes EARLIER step numbers; the
+        # median ring is per-process wall time, not step-indexed, so a
+        # genuine stall right after restore still triggers (no 16-step
+        # re-arming blackout).
+        eng.observe_step_time(3, 0.050)
+        assert eng.trigger_counts == {"slow_step": 1}
+
+    def test_debounced_replay_is_still_journaled(self, tmp_path):
+        # The journal is the decision audit: "fired but suppressed" is
+        # a decision, so the replayed trigger lands there with
+        # debounced=true and no flight-record link.
+        from mercury_tpu.obs.events import EventJournal, read_journal
+
+        journal = EventJournal(str(tmp_path), 0)
+        eng = AnomalyEngine(ring_steps=4, cooldown_steps=100,
+                            dump_dir=str(tmp_path), journal=journal)
+        eng.observe_record(record(50, loss=float("nan")))
+        eng.observe_record(record(10, loss=float("nan")))  # replayed
+        journal.close()
+        events = read_journal(journal.path)
+        assert [e["kind"] for e in events] == ["anomaly/triggered"] * 2
+        first, second = events
+        assert first["detail"]["debounced"] is False
+        assert first["detail"]["flight_record"]
+        assert second["detail"]["debounced"] is True
+        assert second["detail"]["flight_record"] is None
+
+
 class TestTrainerIntegration:
     """The CI smoke as a test: inject a NaN into the host record stream
     mid-run and require a flight record + a loadable perfetto trace."""
