@@ -1,6 +1,6 @@
 """graftlint: static analysis for the JAX hazards this codebase lives with.
 
-Five layers, one entry point (``python -m mercury_tpu.lint``):
+Six layers, one entry point (``python -m mercury_tpu.lint``):
 
 - **Layer 1** (:mod:`mercury_tpu.lint.rules`, :mod:`mercury_tpu.lint.engine`)
   is an AST rule engine over the package's own source with JAX-specific
@@ -72,8 +72,28 @@ Five layers, one entry point (``python -m mercury_tpu.lint``):
   rules GL130–GL133 ride along in Layer 1 (churned closure captures,
   shape-dependent branches, NumPy constants built per-trace, unhashable
   static args). ``--layer perf --regen`` rewrites the golden; a bare
-  ``--regen`` regenerates all four goldens atomically via
+  ``--regen`` regenerates all five goldens atomically via
   :mod:`mercury_tpu.lint.golden`.
+
+- **Layer S** (:mod:`mercury_tpu.lint.control`,
+  :mod:`mercury_tpu.lint.modelcheck`) treats the *control plane* — the
+  supervisor's degradation ladder, restart budgets, SLO latches and
+  recovery probe — as a checked artifact: an AST extractor over
+  ``runtime/supervisor.py`` (plus the scorer-service, anomaly and
+  fault modules) derives the reachable transition system, commits it
+  to ``lint/control_plane.json`` (``--layer control --regen`` parity),
+  and an exhaustive BFS model checker proves the GLS01–GLS06
+  invariants on every regen and verify: uniform is reachable only
+  stepwise, every degraded state can recover, no breach/recover
+  oscillation without an SLO release, restart budgets are monotone
+  within an episode, every modeled transition journals a registered
+  event kind with a rooted parent contract, and levels move ±1 only.
+  The runtime side is a journal-conformance replay
+  (``python -m mercury_tpu.lint.control RUN_DIR``): it re-drives each
+  host's ``events.h*.jsonl`` against the committed machine and flags
+  transitions the model disallows — plus, with ``--coverage``, allowed
+  transitions no chaos run has ever exercised. Pure stdlib, like
+  Layers 1 and C.
 
 See ``docs/LINT.md`` for the rule catalog and ``docs/DESIGN.md`` for the
 audit invariants.

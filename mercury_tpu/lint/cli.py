@@ -24,13 +24,18 @@ Layer selection:
   ``lint/perf_budgets.json`` (``--regen`` parity; the regen also
   re-measures the retrace expectations that the runtime guard,
   ``python -m mercury_tpu.lint.tracecheck``, asserts).
+- ``--layer control``: Layer S — extract the supervisor's control-plane
+  state machine, model-check the GLS01–GLS06 invariants, and verify
+  against the committed ``lint/control_plane.json`` (``--regen``
+  parity; the journal-conformance replay half is
+  ``python -m mercury_tpu.lint.control RUN_DIR``). Pure stdlib.
 - ``--layer all``: all of the above. With ``--diff-out PATH`` the audit
   diff goes to ``PATH``, the sharding diff to ``PATH.sharding``, the
-  thread-manifest diff to ``PATH.threads``, and the perf diff to
-  ``PATH.perf``.
+  thread-manifest diff to ``PATH.threads``, the perf diff to
+  ``PATH.perf``, and the control-plane diff to ``PATH.control``.
 
 ``--regen`` with the default ``--layer ast`` (or ``--layer all``) is the
-one-stop regen: it re-measures EVERY budget layer and rewrites all four
+one-stop regen: it re-measures EVERY budget layer and rewrites all five
 goldens atomically — either every file updates or none does (a plan that
 fails mid-measure cannot leave a half-regenerated set).
 
@@ -66,14 +71,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "jaxpr/HLO structural auditor (Layer 2) + "
                     "sharding & memory auditor (Layer 3) + "
                     "host-concurrency auditor (Layer C) + "
-                    "cost/roofline & retrace auditor (Layer P)",
+                    "cost/roofline & retrace auditor (Layer P) + "
+                    "control-plane model checker (Layer S)",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/directories for Layer 1 (default: the "
                          "mercury_tpu package)")
     ap.add_argument("--layer",
                     choices=("ast", "metrics", "audit", "sharding",
-                             "concurrency", "perf", "all"),
+                             "concurrency", "perf", "control", "all"),
                     default="ast")
     ap.add_argument("--select", action="append", default=None,
                     metavar="RULE",
@@ -99,6 +105,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--perf-budgets", default=None, metavar="PATH",
                     help="Layer P perf_budgets.json to verify against "
                          "/ regenerate")
+    ap.add_argument("--control-plane", default=None, metavar="PATH",
+                    help="Layer S control_plane.json to verify against "
+                         "/ regenerate")
     ap.add_argument("--regen", action="store_true",
                     help="re-measure and WRITE the budget file(s) instead "
                          "of verifying (review the diff before committing)")
@@ -118,7 +127,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.regen and args.layer in ("ast", "all"):
         # One-stop atomic regen: re-measure every budget layer, then
-        # commit all four goldens in a single all-or-nothing batch
+        # commit all five goldens in a single all-or-nothing batch
         # (lint/golden.py::regen_all_goldens). Any measurement or
         # invariant failure aborts before a single committed file moves.
         from mercury_tpu.lint import golden
@@ -139,7 +148,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 budgets_path=args.budgets,
                 shard_budgets_path=args.shard_budgets,
                 manifest_path=args.thread_manifest,
-                perf_budgets_path=args.perf_budgets)
+                perf_budgets_path=args.perf_budgets,
+                control_path=args.control_plane)
         except Exception as exc:  # nothing was committed — say so
             print(f"graftlint regen: aborted with no golden rewritten "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
@@ -193,6 +203,38 @@ def main(argv: Optional[List[str]] = None) -> int:
             if not errors:
                 print("graftlint metrics: emitted keys == registry == "
                       "docs glossary")
+        if errors:
+            rc = 1
+
+    if args.layer in ("control", "all"):
+        from mercury_tpu.lint import control
+
+        diff_out = args.diff_out
+        if diff_out and args.layer == "all":
+            diff_out = diff_out + ".control"
+        try:
+            errors, warnings = control.run_control_check(
+                control_path=args.control_plane,
+                regen=args.regen, diff_out=diff_out)
+        except FileNotFoundError as exc:
+            print(f"graftlint control: control plane missing ({exc}) — "
+                  f"run with --layer control --regen first",
+                  file=sys.stderr)
+            return 2
+        except (OSError, ValueError) as exc:
+            print(f"graftlint control: {exc}", file=sys.stderr)
+            return 2
+        if args.as_json:
+            collect("control", errors, warnings)
+        else:
+            for line in warnings:
+                print(f"warning: {line}")
+            for line in errors:
+                print(line)
+            if not errors:
+                print("graftlint control: machine verified against "
+                      "lint/control_plane.json; invariants "
+                      "GLS01-GLS06 hold")
         if errors:
             rc = 1
 

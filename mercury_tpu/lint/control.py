@@ -1,0 +1,1063 @@
+"""graftlint Layer S: the control plane as an extracted, checked machine.
+
+The supervisor's degradation ladder, its SLO latches, probe pinning and
+restart budgets form a small finite state machine — but until this layer
+it lived implicitly in ~600 lines of ``runtime/supervisor.py`` and its
+peers. Layer S makes it explicit three ways:
+
+1. **Extract** (:func:`extract_control_facts`): an AST walk over
+   ``runtime/supervisor.py``, ``sampling/scorer_service.py``,
+   ``obs/anomaly.py`` and ``faults.py`` pulls the structural facts the
+   machine is built from — the ladder levels, the ±1 transition deltas
+   and their guards, which journal ``kind`` each transition site emits,
+   the SLO breach latch, the probe pin, the restart-budget bookkeeping,
+   the fault alphabet and the anomaly trigger names. Facts are semantic
+   (no line numbers), so the golden only drifts on *behavioral* edits.
+2. **Build + commit** (:func:`build_machine`, :func:`control_doc`): the
+   facts deterministically construct the product transition system
+   (state = ladder level × restart-budget bucket × SLO latch set ×
+   probe-pin flag; every edge annotated with the journal kinds it
+   emits) committed as ``lint/control_plane.json`` (schema
+   ``graftlint_control_plane_v1``) with the standard ``--regen`` /
+   ``--diff-out`` contract from ``lint/golden.py`` — code↔model drift
+   is a lint failure. ``lint/modelcheck.py`` then BFS-explores the
+   machine and proves the GLS01–GLS06 invariants as hard gates.
+3. **Replay** (:func:`check_journal_conformance`): the runtime half,
+   mirroring ``tracecheck.py`` — a recorded ``events.h{p}.jsonl`` is
+   replayed against the committed machine and every observed transition
+   the model does not allow (level skips, re-breach without release,
+   probes while pinned, restarts past exhaustion, non-monotone budget
+   attempts, unregistered kinds, broken parent chains) is a finding.
+   ``python -m mercury_tpu.lint.control RUN_DIR`` is the CI entry the
+   chaos job runs over its fault-matrix artifacts;
+   :func:`conformance_coverage` reports allowed-but-never-observed
+   transitions so the chaos matrix's blind spots are visible too.
+
+Everything here is stdlib-only (AST + JSON): the lint-control CI job and
+the chaos replay both run on jax-free machines. The replay is rotation-
+and torn-shard-tolerant: unknown state components bind from the first
+event that declares them (a rotated shard is a suffix of a valid run),
+and only *contradictions* with already-replayed state are violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from mercury_tpu.lint import golden
+
+__all__ = [
+    "CONTROL_SCHEMA", "extract_control_facts", "check_extraction",
+    "build_machine", "control_doc", "default_control_path",
+    "run_control_check", "check_journal_conformance",
+    "conformance_coverage",
+]
+
+#: Golden schema tag; bump on any incompatible machine-shape change.
+CONTROL_SCHEMA = "graftlint_control_plane_v1"
+
+REGEN_HINT = "python -m mercury_tpu.lint --layer control --regen"
+
+#: The modules the extractor walks, keyed by the short name facts use.
+CONTROL_MODULES: Dict[str, str] = {
+    "supervisor": os.path.join("runtime", "supervisor.py"),
+    "scorer_service": os.path.join("sampling", "scorer_service.py"),
+    "anomaly": os.path.join("obs", "anomaly.py"),
+    "faults": "faults.py",
+}
+
+#: Supervisor methods that move control-plane state; each MUST journal
+#: what it did (an unjournaled transition is invisible to the replay —
+#: GLS11 makes that a lint failure, not a silent gap).
+TRANSITION_SITES = ("_degrade", "_recover", "_try_restart",
+                    "_note_exhausted", "_check_slos", "_maybe_probe")
+
+#: Modeled SLO latch slots. The trainer registers one ladder SLO today
+#: (``scorer_service``); two slots leave headroom while keeping the
+#: product space small (4 levels × 4 buckets × 2² latch sets).
+MODEL_SLO_SLOTS = ("slo0", "slo1")
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_control_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "control_plane.json")
+
+
+def _registry_path() -> str:
+    return os.path.join(_package_root(), "obs", "registry.py")
+
+
+def _registered_kinds() -> Dict[str, str]:
+    from mercury_tpu.lint.metrics import load_event_registry
+
+    return load_event_registry(_registry_path())
+
+
+# --------------------------------------------------------------------------
+# AST fact extraction
+# --------------------------------------------------------------------------
+
+def _module_tree(key: str,
+                 sources: Optional[Dict[str, str]] = None) -> ast.AST:
+    rel = CONTROL_MODULES[key]
+    if sources is not None and key in sources:
+        return ast.parse(sources[key], filename=f"<fixture:{rel}>")
+    path = os.path.join(_package_root(), rel)
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _methods(tree: ast.AST, class_name: str) -> Dict[str, ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {n.name: n for n in node.body
+                    if isinstance(n, ast.FunctionDef)}
+    return {}
+
+
+def _module_literal(tree: ast.AST, name: str) -> Optional[Any]:
+    """Value of a module-level ``NAME = <literal>`` assignment.
+    ``frozenset({...})`` unwraps to its argument (the fault alphabet)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        value = node.value
+        if (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "frozenset" and value.args):
+            value = value.args[0]
+        try:
+            return ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            return None
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _emit_kinds(fn: ast.AST) -> List[str]:
+    """Journal kinds emitted inside ``fn`` — first-positional string
+    constants of calls whose attribute contains ``emit`` and whose
+    dotted callable name contains ``journal`` (the same producer-call
+    signature Layer M's GLM04 census keys on)."""
+    kinds: List[str] = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and "emit" in node.func.attr
+                and "journal" in _dotted(node.func).lower()
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            kinds.append(node.args[0].value)
+    return sorted(set(kinds))
+
+
+def _is_level_attr(node: ast.AST) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == "_level"
+
+
+def _level_delta(fn: ast.AST) -> Optional[int]:
+    """The signed step applied to ``self._level`` inside ``fn``: follows
+    ``src = self._level; self._level = src ± k`` as well as the direct
+    and augmented forms. None when the function never writes the level."""
+    bound = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and _is_level_attr(node.value)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+
+    def from_level(node: ast.AST) -> bool:
+        return (_is_level_attr(node)
+                or (isinstance(node, ast.Name) and node.id in bound))
+
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.AugAssign) and _is_level_attr(node.target)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            k = node.value.value
+            return k if isinstance(node.op, ast.Add) else -k
+        if (isinstance(node, ast.Assign)
+                and any(_is_level_attr(t) for t in node.targets)
+                and isinstance(node.value, ast.BinOp)
+                and from_level(node.value.left)
+                and isinstance(node.value.right, ast.Constant)
+                and isinstance(node.value.right.value, int)):
+            k = node.value.right.value
+            if isinstance(node.value.op, ast.Add):
+                return k
+            if isinstance(node.value.op, ast.Sub):
+                return -k
+    return None
+
+
+def _has_level_guard(fn: ast.AST, ops: Tuple[type, ...]) -> bool:
+    """An ``if self._level <cmp> ...: return`` early-out — the absorbing
+    top (``>=``) / floor (``<=``) guard of the ladder."""
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.If)
+                and isinstance(node.test, ast.Compare)
+                and _is_level_attr(node.test.left)
+                and len(node.test.ops) == 1
+                and isinstance(node.test.ops[0], ops)
+                and any(isinstance(b, ast.Return)
+                        for b in ast.walk(node))):
+            return True
+    return False
+
+
+def _assigns_attr(fn: ast.AST, attr: str,
+                  value: Any = ...) -> bool:
+    """``<expr>.attr = ...`` anywhere in ``fn`` (optionally requiring a
+    specific constant value)."""
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Attribute) and t.attr == attr
+                        for t in node.targets)):
+            if value is ...:
+                return True
+            if (isinstance(node.value, ast.Constant)
+                    and node.value.value == value):
+                return True
+    return False
+
+
+def _calls_method(fn: ast.AST, names: Tuple[str, ...]) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in names):
+            return True
+    return False
+
+
+def _budget_reset_on_full_recovery(fn: ast.AST) -> bool:
+    """``if dst == 0:`` (comparison against the constant 0) wrapping a
+    ``restarts_used = 0`` reset — the budget refresh is gated on landing
+    at the BOTTOM of the ladder, not on any ascent."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.If)
+                and isinstance(node.test, ast.Compare)
+                and len(node.test.ops) == 1
+                and isinstance(node.test.ops[0], ast.Eq)
+                and any(isinstance(c, ast.Constant) and c.value == 0
+                        for c in node.test.comparators)):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign)
+                    and any(isinstance(t, ast.Attribute)
+                            and t.attr == "restarts_used"
+                            for t in sub.targets)
+                    and isinstance(sub.value, ast.Constant)
+                    and sub.value.value == 0):
+                return True
+    return False
+
+
+def _probe_pinned_by_slo(fn: ast.AST) -> bool:
+    """``any(... .breached ...)`` feeding the probe's due condition —
+    the pin that holds recovery while any SLO is latched."""
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "any"):
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Attribute)
+                        and sub.attr == "breached"):
+                    return True
+    return False
+
+
+def _increments_attr(fn: ast.AST, attr: str) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.AugAssign)
+                and isinstance(node.target, ast.Attribute)
+                and node.target.attr == attr
+                and isinstance(node.op, ast.Add)):
+            return True
+    return False
+
+
+def _once_latch(fn: ast.AST, attr: str) -> bool:
+    """``if x.attr: return`` + ``x.attr = True`` — the handled-once
+    latch that stops a persistent condition from re-firing every tick."""
+    guarded = any(
+        isinstance(node, ast.If)
+        and isinstance(node.test, ast.Attribute)
+        and node.test.attr == attr
+        and any(isinstance(b, ast.Return) for b in node.body)
+        for node in ast.walk(fn))
+    return guarded and _assigns_attr(fn, attr, True)
+
+
+def _trigger_kinds(tree: ast.AST) -> List[str]:
+    """First-arg string constants of ``self._trigger(...)`` calls — the
+    anomaly engine's trigger alphabet."""
+    kinds = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_trigger"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            kinds.append(node.args[0].value)
+    return sorted(set(kinds))
+
+
+def extract_control_facts(
+        sources: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Walk the control-plane modules and return the structural facts
+    the machine is built from. ``sources`` overrides module source text
+    by :data:`CONTROL_MODULES` key (seeded-violation fixtures)."""
+    sup_tree = _module_tree("supervisor", sources)
+    svc_tree = _module_tree("scorer_service", sources)
+    ano_tree = _module_tree("anomaly", sources)
+    flt_tree = _module_tree("faults", sources)
+
+    methods = _methods(sup_tree, "HostSupervisor")
+    sites = {name: (_emit_kinds(methods[name]) if name in methods else None)
+             for name in TRANSITION_SITES}
+
+    def fn(name: str) -> ast.AST:
+        return methods.get(name, ast.parse("pass"))
+
+    levels = _module_literal(sup_tree, "LEVEL_NAMES")
+    buckets = _module_literal(sup_tree, "BUDGET_BUCKETS")
+    fault_kinds = _module_literal(flt_tree, "KNOWN_KINDS")
+
+    svc_kinds: List[str] = []
+    for node in ast.walk(svc_tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            svc_kinds.extend(_emit_kinds(node))
+
+    facts: Dict[str, Any] = {
+        "modules": {k: CONTROL_MODULES[k].replace(os.sep, "/")
+                    for k in sorted(CONTROL_MODULES)},
+        "levels": list(levels) if levels else [],
+        "buckets": list(buckets) if buckets else [],
+        "degrade": {
+            "delta": _level_delta(fn("_degrade")),
+            "absorbing_guard": _has_level_guard(fn("_degrade"),
+                                                (ast.GtE, ast.Gt)),
+            "emits": sites.get("_degrade") or [],
+        },
+        "recover": {
+            "delta": _level_delta(fn("_recover")),
+            "floor_guard": _has_level_guard(fn("_recover"),
+                                            (ast.LtE, ast.Lt)),
+            "budget_reset_on_full_recovery":
+                _budget_reset_on_full_recovery(fn("_recover")),
+            "emits": sites.get("_recover") or [],
+        },
+        "slo": {
+            "latched": _assigns_attr(fn("_check_slos"), "breached"),
+            "breach_degrades": _calls_method(fn("_check_slos"),
+                                             ("_degrade",)),
+            "emits_breach": [k for k in sites.get("_check_slos") or []
+                             if k.endswith("breach")],
+            "emits_release": [k for k in sites.get("_check_slos") or []
+                              if k.endswith("release")],
+        },
+        "probe": {
+            "pinned_by_latched_slo":
+                _probe_pinned_by_slo(fn("_maybe_probe")),
+            "ok_recovers": _calls_method(fn("_maybe_probe"),
+                                         ("_recover",)),
+            "fail_degrades": _calls_method(fn("_maybe_probe"),
+                                           ("report_failure", "_degrade")),
+            "emits_ok": [k for k in sites.get("_maybe_probe") or []
+                         if k.endswith("_ok")],
+            "emits_fail": [k for k in sites.get("_maybe_probe") or []
+                           if k.endswith("failed")],
+        },
+        "restart": {
+            "consumes_budget_on_attempt":
+                _increments_attr(fn("_try_restart"), "restarts_used"),
+            "emits_ok": [k for k in sites.get("_try_restart") or []
+                         if not k.endswith("failed")],
+            "emits_fail": [k for k in sites.get("_try_restart") or []
+                           if k.endswith("failed")],
+        },
+        "exhaustion": {
+            "once_latched": _once_latch(fn("_note_exhausted"),
+                                        "exhausted_handled"),
+            "escalates_degrade": _calls_method(fn("_note_exhausted"),
+                                               ("_degrade",)),
+            "emits": sites.get("_note_exhausted") or [],
+        },
+        "transition_sites": sites,
+        "fault_kinds": sorted(fault_kinds) if fault_kinds else [],
+        "anomaly_triggers": _trigger_kinds(ano_tree),
+        "peer_kinds": {
+            "scorer_service": sorted(set(svc_kinds)),
+            "faults": _emit_kinds(flt_tree),
+            "anomaly": _emit_kinds(ano_tree),
+        },
+        "scorer_slo_latched": any(
+            _assigns_attr(node, "slo_latched", True)
+            for node in ast.walk(svc_tree)
+            if isinstance(node, ast.FunctionDef)),
+    }
+    kinds: List[str] = []
+    for site_kinds in sites.values():
+        kinds.extend(site_kinds or [])
+    facts["supervisor_kinds"] = sorted(set(kinds))
+    return facts
+
+
+# --------------------------------------------------------------------------
+# static extraction gates (GLS10–GLS13)
+# --------------------------------------------------------------------------
+
+def check_extraction(facts: Dict[str, Any],
+                     registered: Optional[Dict[str, str]] = None
+                     ) -> List[str]:
+    """Hard gates on the extracted facts themselves — violations the
+    extractor can prove without building the machine (the level-skip and
+    unjournaled-transition fixtures are caught here)."""
+    errors: List[str] = []
+    if not facts["levels"]:
+        errors.append("GLS10 control: LEVEL_NAMES not extractable from "
+                      "runtime/supervisor.py")
+    if not facts["buckets"]:
+        errors.append("GLS10 control: BUDGET_BUCKETS not extractable "
+                      "from runtime/supervisor.py")
+    if facts["degrade"]["delta"] != 1:
+        errors.append(
+            f"GLS10 control: _degrade moves the ladder by "
+            f"{facts['degrade']['delta']} — levels must change by +1 "
+            f"only (one level per decision, no skips)")
+    if facts["recover"]["delta"] != -1:
+        errors.append(
+            f"GLS10 control: _recover moves the ladder by "
+            f"{facts['recover']['delta']} — levels must change by -1 "
+            f"only (one probe success climbs one level)")
+    if not facts["degrade"]["absorbing_guard"]:
+        errors.append("GLS10 control: _degrade has no top-of-ladder "
+                      "guard — uniform must be absorbing")
+    if not facts["recover"]["floor_guard"]:
+        errors.append("GLS10 control: _recover has no level-0 floor "
+                      "guard")
+    for site, kinds in facts["transition_sites"].items():
+        if kinds is None:
+            errors.append(f"GLS11 control: transition site {site} not "
+                          f"found in HostSupervisor")
+        elif not kinds:
+            errors.append(
+                f"GLS11 control: transition site {site} emits no "
+                f"journal kind — every control-plane transition must "
+                f"be journaled (the conformance replay cannot see an "
+                f"unjournaled move)")
+    if not facts["recover"]["budget_reset_on_full_recovery"]:
+        errors.append("GLS12 control: _recover does not reset restart "
+                      "budgets on full recovery (dst == 0) — budgets "
+                      "must reset exactly there and nowhere else")
+    if not facts["restart"]["consumes_budget_on_attempt"]:
+        errors.append("GLS12 control: _try_restart does not consume "
+                      "budget on the attempt — budgets must be "
+                      "monotone within an episode")
+    if not facts["exhaustion"]["once_latched"]:
+        errors.append("GLS12 control: _note_exhausted is not once-"
+                      "latched (exhausted_handled) — a persistent "
+                      "exhaustion would re-fire every tick")
+    if registered is None:
+        registered = _registered_kinds()
+    emitted = set(facts["supervisor_kinds"])
+    for kinds in facts["peer_kinds"].values():
+        emitted.update(kinds)
+    for kind in sorted(emitted - set(registered)):
+        errors.append(f"GLS13 control: emitted journal kind {kind!r} "
+                      f"is not in obs/registry.py::EVENT_KINDS")
+    return errors
+
+
+# --------------------------------------------------------------------------
+# machine construction
+# --------------------------------------------------------------------------
+
+def _state_id(level: int, bucket: str, latched: frozenset,
+              pinned: bool) -> str:
+    latch = "+".join(sorted(latched)) if latched else "none"
+    return f"L{level}/{bucket}/{latch}/{'pinned' if pinned else 'free'}"
+
+
+def build_machine(facts: Dict[str, Any]) -> Dict[str, Any]:
+    """Construct the explicit product transition system from the facts.
+
+    Deterministic (sorted worklist, stable edge order) so the committed
+    golden is byte-stable across regens. The budget component abstracts
+    ``restarts_used``/``restart_budget`` into the ordered buckets of
+    ``BUDGET_BUCKETS``; a restart attempt lands in ``partial`` or
+    ``spent`` nondeterministically (the concrete budget is config), and
+    exhaustion is reachable from any non-exhausted bucket (budget 0
+    exhausts without any attempt)."""
+    levels: List[str] = facts["levels"]
+    buckets: List[str] = facts["buckets"] or ["fresh", "partial",
+                                              "spent", "exhausted"]
+    top = len(levels) - 1
+    fresh, exhausted = buckets[0], buckets[-1]
+    attempt_targets = [b for b in buckets[1:-1]]  # partial, spent
+    latch_on = bool(facts["slo"]["latched"])
+    pin_on = bool(facts["probe"]["pinned_by_latched_slo"])
+    d_delta = facts["degrade"]["delta"] or 1
+    r_delta = facts["recover"]["delta"] or -1
+    d_emits = facts["degrade"]["emits"]
+    r_emits = facts["recover"]["emits"]
+
+    def deg(level: int) -> Optional[int]:
+        dst = level + d_delta
+        return dst if 0 <= dst <= top else None
+
+    def rec(level: int) -> Optional[int]:
+        dst = level + r_delta
+        return dst if 0 <= dst <= top else None
+
+    def edges_from(state: Tuple[int, str, frozenset]
+                   ) -> List[Tuple[str, Tuple[int, str, frozenset],
+                                   List[str]]]:
+        level, bucket, latched = state
+        pinned = pin_on and bool(latched)
+        out = []
+        # Restart attempts: budget consumed on the attempt, success or
+        # failure alike; the bucket only ever moves up the order.
+        if bucket not in (buckets[-2], exhausted):
+            for nb in attempt_targets:
+                out.append(("restart_ok", (level, nb, latched),
+                            list(facts["restart"]["emits_ok"])))
+                out.append(("restart_fail", (level, nb, latched),
+                            list(facts["restart"]["emits_fail"])))
+        elif bucket == buckets[-2]:
+            pass  # spent: no attempts left, only exhaustion
+        # Exhaustion of the escalating unit: once-latched, degrades one
+        # level unless already at the absorbing top (where _degrade's
+        # guard returns before journaling — only `exhausted` is emitted).
+        if bucket != exhausted:
+            emits = list(facts["exhaustion"]["emits"])
+            nl = level
+            if facts["exhaustion"]["escalates_degrade"]:
+                d = deg(level)
+                if d is not None:
+                    emits += d_emits
+                    nl = d
+            out.append(("unit_exhausted", (nl, exhausted, latched),
+                        emits))
+        # SLO breach (rising edge, latches) / release (falling edge).
+        for slot in MODEL_SLO_SLOTS:
+            if latch_on and slot in latched:
+                out.append((f"slo_release:{slot}",
+                            (level, bucket, latched - {slot}),
+                            list(facts["slo"]["emits_release"])))
+                continue
+            emits = list(facts["slo"]["emits_breach"])
+            nl = level
+            if facts["slo"]["breach_degrades"]:
+                d = deg(level)
+                if d is not None:
+                    emits += d_emits
+                    nl = d
+            nlat = (latched | {slot}) if latch_on else latched
+            out.append((f"slo_breach:{slot}", (nl, bucket, nlat), emits))
+        # Recovery probes: only while degraded and not pinned; the climb
+        # into level 0 refreshes the restart budget.
+        if level > 0 and not pinned:
+            r = rec(level)
+            if r is not None and facts["probe"]["ok_recovers"]:
+                nb = fresh if r == 0 else bucket
+                out.append(("probe_ok", (r, nb, latched),
+                            list(facts["probe"]["emits_ok"]) + r_emits))
+            emits = list(facts["probe"]["emits_fail"])
+            nl = level
+            if facts["probe"]["fail_degrades"]:
+                d = deg(level)
+                if d is not None:
+                    emits += d_emits
+                    nl = d
+            out.append(("probe_fail", (nl, bucket, latched), emits))
+        # A degraded-path action failing on the trainer thread (the
+        # level-1 sync refresh raising) escalates with no causal parent.
+        if 0 < level < top:
+            d = deg(level)
+            if d is not None:
+                out.append(("degraded_path_fail", (d, bucket, latched),
+                            list(d_emits)))
+        return out
+
+    initial = (0, fresh, frozenset())
+    seen = {initial}
+    order = [initial]
+    edges: List[Dict[str, Any]] = []
+    frontier = [initial]
+    while frontier:
+        nxt: List[Tuple[int, str, frozenset]] = []
+        for state in frontier:
+            for inp, dst, emits in edges_from(state):
+                pinned_src = pin_on and bool(state[2])
+                pinned_dst = pin_on and bool(dst[2])
+                edges.append({
+                    "from": _state_id(state[0], state[1], state[2],
+                                      pinned_src),
+                    "input": inp,
+                    "to": _state_id(dst[0], dst[1], dst[2], pinned_dst),
+                    "emits": emits,
+                })
+                if dst not in seen:
+                    seen.add(dst)
+                    order.append(dst)
+                    nxt.append(dst)
+        frontier = sorted(nxt)
+
+    states = [{
+        "id": _state_id(lv, b, lat, pin_on and bool(lat)),
+        "level": lv, "bucket": b, "latched": sorted(lat),
+        "pinned": pin_on and bool(lat),
+    } for lv, b, lat in order]
+
+    # Parent-chain contract per kind: derived from same-edge emit
+    # ordering (the second emit parents to the first) plus the static
+    # causal links the code threads through stored event ids.
+    parents: Dict[str, List[Optional[str]]] = {}
+    for kind in facts["supervisor_kinds"]:
+        parents[kind] = []
+    for e in edges:
+        for a, b in zip(e["emits"], e["emits"][1:]):
+            if b in parents and a not in parents[b]:
+                parents[b].append(a)
+    static_parents: Dict[str, List[Optional[str]]] = {
+        "supervisor/slo_breach": [None],
+        "supervisor/slo_release": ["supervisor/slo_breach", None],
+        "supervisor/degrade": [None],
+        "supervisor/restart": [None],
+        "supervisor/restart_failed": [None],
+        "supervisor/exhausted": ["supervisor/restart_failed", None],
+        "supervisor/probe_ok": ["supervisor/degrade", None],
+        "supervisor/probe_failed": ["supervisor/degrade", None],
+    }
+    for kind, extra in static_parents.items():
+        if kind in parents:
+            for p in extra:
+                if p not in parents[kind]:
+                    parents[kind].append(p)
+
+    kind_rules: Dict[str, Dict[str, Any]] = {}
+
+    def _from_levels(kind: str) -> List[int]:
+        out = set()
+        lv = {s["id"]: s["level"] for s in states}
+        for e in edges:
+            if kind in e["emits"]:
+                out.add(lv[e["from"]])
+        return sorted(out)
+
+    for kind in facts["degrade"]["emits"]:
+        kind_rules[kind] = {"delta": d_delta,
+                            "from_levels": _from_levels(kind)}
+    for kind in facts["recover"]["emits"]:
+        kind_rules[kind] = {"delta": r_delta,
+                            "from_levels": _from_levels(kind),
+                            "requires_unpinned": pin_on,
+                            "resets_buckets_at": 0}
+    for kind in facts["probe"]["emits_ok"]:
+        kind_rules[kind] = {"probe": True,
+                            "from_levels": _from_levels(kind),
+                            "requires_unpinned": pin_on}
+    for kind in facts["probe"]["emits_fail"]:
+        kind_rules[kind] = {"probe": True,
+                            "from_levels": _from_levels(kind),
+                            "requires_unpinned": pin_on}
+    for kind in facts["slo"]["emits_breach"]:
+        kind_rules[kind] = {"latch": "set" if latch_on else "none"}
+    for kind in facts["slo"]["emits_release"]:
+        kind_rules[kind] = {"latch": "clear" if latch_on else "none"}
+    for kind in facts["restart"]["emits_ok"]:
+        kind_rules[kind] = {"budget": "attempt"}
+    for kind in facts["restart"]["emits_fail"]:
+        kind_rules[kind] = {"budget": "attempt"}
+    for kind in facts["exhaustion"]["emits"]:
+        kind_rules[kind] = {"budget": "exhaust"}
+
+    registered = _registered_kinds()
+    ambient = sorted(set(registered) - set(facts["supervisor_kinds"]))
+
+    return {
+        "initial": _state_id(*initial, False),
+        "levels": list(levels),
+        "buckets": list(buckets),
+        "slo_slots": list(MODEL_SLO_SLOTS),
+        "alphabet": {
+            "ladder_inputs": sorted({e["input"] for e in edges}),
+            "ambient_inputs": (
+                [f"fault:{k}" for k in facts["fault_kinds"]]
+                + [f"anomaly:{t}" for t in facts["anomaly_triggers"]]),
+        },
+        "states": states,
+        "edges": edges,
+        "kind_rules": kind_rules,
+        "parents": parents,
+        "ambient_kinds": ambient,
+    }
+
+
+def control_doc(facts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The committed golden document. Provenance carries only the regen
+    command (no jax versions — Layer S is stdlib-only and the golden
+    must not drift on toolchain upgrades)."""
+    if facts is None:
+        facts = extract_control_facts()
+    return {
+        "schema": CONTROL_SCHEMA,
+        "provenance": {"regenerate_with": REGEN_HINT},
+        "facts": facts,
+        "machine": build_machine(facts),
+    }
+
+
+# --------------------------------------------------------------------------
+# golden verify / regen (the --layer control CLI contract)
+# --------------------------------------------------------------------------
+
+def _doc_diff(committed: Dict[str, Any],
+              fresh: Dict[str, Any]) -> List[str]:
+    lines: List[str] = []
+    for section in ("facts", "machine"):
+        a, b = committed.get(section, {}), fresh.get(section, {})
+        for key in sorted(set(a) | set(b)):
+            va, vb = a.get(key), b.get(key)
+            if va == vb:
+                continue
+            if key in ("states", "edges") and isinstance(va, list) \
+                    and isinstance(vb, list):
+                ka = {json.dumps(x, sort_keys=True) for x in va}
+                kb = {json.dumps(x, sort_keys=True) for x in vb}
+                for gone in sorted(ka - kb)[:5]:
+                    lines.append(f"  {section}.{key}: committed-only "
+                                 f"{gone}")
+                for new in sorted(kb - ka)[:5]:
+                    lines.append(f"  {section}.{key}: code-only {new}")
+                lines.append(f"  {section}.{key}: {len(va)} committed "
+                             f"vs {len(vb)} extracted")
+            else:
+                lines.append(f"  {section}.{key}: committed "
+                             f"{json.dumps(va, sort_keys=True)[:200]} "
+                             f"vs extracted "
+                             f"{json.dumps(vb, sort_keys=True)[:200]}")
+    if lines:
+        lines.insert(0, "control plane drifted from committed model "
+                        f"(regenerate with {REGEN_HINT}):")
+    return lines
+
+
+def run_control_check(control_path: Optional[str] = None,
+                      regen: bool = False,
+                      diff_out: Optional[str] = None,
+                      ) -> Tuple[List[str], List[str]]:
+    """Layer S entry: extract, model-check, and verify (or ``--regen``)
+    the committed control plane. Returns ``(errors, warnings)`` on the
+    shared layer-CLI contract; raises FileNotFoundError when verifying
+    with no committed golden (the CLI maps it to exit 2 + regen hint)."""
+    from mercury_tpu.lint import modelcheck
+
+    path = control_path or default_control_path()
+    facts = extract_control_facts()
+    errors = check_extraction(facts)
+    doc = control_doc(facts)
+    errors.extend(modelcheck.check_invariants(doc["machine"]))
+    warnings: List[str] = []
+    if regen:
+        golden.write_golden(path, doc)
+        warnings.append(f"control plane written to {path}")
+        return errors, warnings
+    committed = golden.load_golden(path, CONTROL_SCHEMA, REGEN_HINT)
+    diff = _doc_diff(committed, doc)
+    if diff:
+        errors.extend(diff)
+        if diff_out:
+            golden.write_diff_file(diff_out,
+                                   "graftlint control-plane diff", diff)
+    return errors, warnings
+
+
+# --------------------------------------------------------------------------
+# runtime half: journal conformance replay
+# --------------------------------------------------------------------------
+
+def _load_machine(control_path: Optional[str] = None) -> Dict[str, Any]:
+    doc = golden.load_golden(control_path or default_control_path(),
+                             CONTROL_SCHEMA, REGEN_HINT)
+    return doc["machine"]
+
+
+def check_journal_conformance(events: Sequence[Dict[str, Any]],
+                              machine: Optional[Dict[str, Any]] = None,
+                              ) -> List[str]:
+    """Replay recorded journal events against the committed machine;
+    returns one finding per observed transition the model does not
+    allow (empty = conformant).
+
+    The replay is per-host and binds unknown state components from the
+    first event that declares them, so a rotated shard (a suffix of a
+    valid run) and a torn final line replay clean — only contradictions
+    with already-replayed state are violations."""
+    if machine is None:
+        machine = _load_machine()
+    by_host: Dict[int, List[Dict[str, Any]]] = {}
+    for evt in events:
+        if isinstance(evt, dict):
+            by_host.setdefault(int(evt.get("host", 0)), []).append(evt)
+    findings: List[str] = []
+    for host in sorted(by_host):
+        findings.extend(_replay_host(host, by_host[host], machine))
+    return findings
+
+
+def _replay_host(host: int, events: List[Dict[str, Any]],
+                 machine: Dict[str, Any]) -> List[str]:
+    rules = machine["kind_rules"]
+    parents = machine["parents"]
+    ambient = set(machine["ambient_kinds"])
+    levels: List[str] = machine["levels"]
+    buckets: List[str] = machine["buckets"]
+    fresh, exhausted = buckets[0], buckets[-1]
+    order = {b: i for i, b in enumerate(buckets)}
+    findings: List[str] = []
+    level: Optional[int] = None      # unknown until anchored
+    latched: Dict[str, bool] = {}    # SLO name -> latch bit (known only)
+    unit_bucket: Dict[str, str] = {}
+    unit_attempt: Dict[str, int] = {}
+    by_id: Dict[str, str] = {}       # event_id -> kind (earlier events)
+
+    def flag(evt: Dict[str, Any], msg: str) -> None:
+        findings.append(f"h{host} {evt.get('event_id')} "
+                        f"step {evt.get('step')}: {msg}")
+
+    for evt in events:
+        kind = evt.get("kind")
+        detail = evt.get("detail") or {}
+        if kind in ambient:
+            by_id[evt.get("event_id", "")] = kind
+            continue
+        if kind not in rules:
+            flag(evt, f"journal kind {kind!r} is not in the model "
+                      f"(unregistered or unmodeled transition)")
+            by_id[evt.get("event_id", "")] = str(kind)
+            continue
+        rule = rules[kind]
+        allowed = parents.get(kind)
+        pid = evt.get("parent_id")
+        if allowed is not None:
+            if pid is None:
+                if None not in allowed:
+                    flag(evt, f"{kind} with no parent — the model "
+                              f"requires a causal parent in {allowed}")
+            elif pid in by_id and by_id[pid] not in allowed:
+                flag(evt, f"{kind} parented to {by_id[pid]} — the "
+                          f"model allows {allowed}")
+
+        if "delta" in rule:  # degrade / recover
+            frm, to = detail.get("from"), detail.get("to")
+            if frm not in levels or to not in levels:
+                flag(evt, f"{kind} between unknown levels "
+                          f"{frm!r} -> {to!r}")
+            else:
+                fi, ti = levels.index(frm), levels.index(to)
+                if ti - fi != rule["delta"]:
+                    flag(evt, f"{kind} {frm} -> {to} skips levels — "
+                              f"the model moves by {rule['delta']:+d} "
+                              f"only")
+                if level is None:
+                    level = fi
+                elif level != fi:
+                    flag(evt, f"{kind} declares from={frm} but the "
+                              f"replayed state is "
+                              f"{levels[level]} — a transition between "
+                              f"them was not journaled")
+                if (rule.get("requires_unpinned")
+                        and any(latched.values())):
+                    pinned = sorted(k for k, v in latched.items() if v)
+                    flag(evt, f"{kind} while SLO(s) {pinned} are "
+                              f"latched — the probe pin forbids "
+                              f"recovery until every SLO releases")
+                level = ti
+                if (rule.get("resets_buckets_at") == ti):
+                    unit_bucket = {u: fresh for u in unit_bucket}
+                    unit_attempt = {}
+        elif rule.get("probe"):
+            lv = detail.get("level")
+            if isinstance(lv, int) and 0 <= lv < len(levels):
+                if level is None:
+                    level = lv
+                elif level != lv:
+                    flag(evt, f"{kind} at declared level "
+                              f"{levels[lv]} but the replayed state "
+                              f"is {levels[level]}")
+            if level == 0:
+                flag(evt, f"{kind} at level 0 — probes only run while "
+                          f"degraded")
+            if rule.get("requires_unpinned") and any(latched.values()):
+                pinned = sorted(k for k, v in latched.items() if v)
+                flag(evt, f"{kind} while SLO(s) {pinned} are latched — "
+                          f"the pin holds probes until release")
+        elif "latch" in rule:
+            slo = str(detail.get("slo", "?"))
+            if rule["latch"] == "set":
+                if latched.get(slo) is True:
+                    flag(evt, f"re-breach of SLO {slo!r} without a "
+                              f"release — the rising-edge latch allows "
+                              f"one breach per episode")
+                latched[slo] = True
+            elif rule["latch"] == "clear":
+                if latched.get(slo) is False:
+                    flag(evt, f"release of SLO {slo!r} that was not "
+                              f"latched")
+                latched[slo] = False
+        elif "budget" in rule:
+            unit = str(detail.get("unit", "?"))
+            if rule["budget"] == "attempt":
+                if unit_bucket.get(unit) == exhausted:
+                    flag(evt, f"restart of {unit!r} after exhaustion — "
+                              f"budgets reset only on full recovery")
+                attempt = detail.get("attempt")
+                budget = detail.get("budget")
+                if isinstance(attempt, int):
+                    last = unit_attempt.get(unit)
+                    if last is not None and attempt <= last:
+                        flag(evt, f"restart attempt {attempt} of "
+                                  f"{unit!r} after attempt {last} — "
+                                  f"budget use must be monotone within "
+                                  f"an episode")
+                    unit_attempt[unit] = attempt
+                    nb = (buckets[-2]
+                          if isinstance(budget, int) and attempt >= budget
+                          else buckets[1])
+                    if order[nb] >= order.get(
+                            unit_bucket.get(unit, fresh), 0):
+                        unit_bucket[unit] = nb
+            elif rule["budget"] == "exhaust":
+                if unit_bucket.get(unit) == exhausted:
+                    flag(evt, f"duplicate exhaustion of {unit!r} — "
+                              f"exhaustion is once-latched per episode")
+                unit_bucket[unit] = exhausted
+        by_id[evt.get("event_id", "")] = str(kind)
+    return findings
+
+
+def conformance_coverage(events: Sequence[Dict[str, Any]],
+                         machine: Optional[Dict[str, Any]] = None,
+                         ) -> List[str]:
+    """Allowed-but-never-observed transitions across a run (or a whole
+    chaos matrix): one warning per modeled kind (and per allowed source
+    level for ladder kinds) that no event exercised. Coverage gaps are
+    chaos-matrix blind spots, not failures."""
+    if machine is None:
+        machine = _load_machine()
+    rules = machine["kind_rules"]
+    levels: List[str] = machine["levels"]
+    seen_kinds = set()
+    seen_levels: Dict[str, set] = {}
+    for evt in events:
+        if not isinstance(evt, dict):
+            continue
+        kind = evt.get("kind")
+        if kind not in rules:
+            continue
+        seen_kinds.add(kind)
+        detail = evt.get("detail") or {}
+        frm = detail.get("from")
+        if isinstance(frm, str) and frm in levels:
+            seen_levels.setdefault(kind, set()).add(levels.index(frm))
+        lv = detail.get("level")
+        if isinstance(lv, int):
+            seen_levels.setdefault(kind, set()).add(lv)
+    gaps: List[str] = []
+    for kind in sorted(rules):
+        if kind not in seen_kinds:
+            gaps.append(f"coverage: modeled kind {kind} never observed")
+            continue
+        for lv in rules[kind].get("from_levels", []):
+            if lv not in seen_levels.get(kind, set()):
+                gaps.append(f"coverage: {kind} never observed from "
+                            f"level {levels[lv]}")
+    return gaps
+
+
+# --------------------------------------------------------------------------
+# CLI: python -m mercury_tpu.lint.control RUN_DIR [...]
+# --------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from mercury_tpu.obs.events import load_events
+
+    ap = argparse.ArgumentParser(
+        prog="python -m mercury_tpu.lint.control",
+        description="Replay recorded event journals against the "
+                    "committed control-plane machine "
+                    "(lint/control_plane.json); exit 1 on any "
+                    "nonconforming transition.")
+    ap.add_argument("run_dirs", nargs="+",
+                    help="run directories containing events.h*.jsonl")
+    ap.add_argument("--control-plane", default=None, metavar="PATH",
+                    help="machine golden to replay against (default: "
+                         "the committed lint/control_plane.json)")
+    ap.add_argument("--coverage", action="store_true",
+                    help="also report modeled transitions never "
+                         "observed across the given runs (warnings)")
+    args = ap.parse_args(argv)
+
+    try:
+        machine = _load_machine(args.control_plane)
+    except FileNotFoundError as exc:
+        print(f"graftlint control: machine golden missing ({exc}) — "
+              f"run {REGEN_HINT} first", file=sys.stderr)
+        return 2
+    except (OSError, ValueError) as exc:
+        print(f"graftlint control: {exc}", file=sys.stderr)
+        return 2
+
+    rc = 0
+    merged: List[Dict[str, Any]] = []
+    for run_dir in args.run_dirs:
+        events = load_events(run_dir)
+        if not events:
+            print(f"graftlint control: no journal events under "
+                  f"{run_dir} (expected events.h*.jsonl)",
+                  file=sys.stderr)
+            rc = 2
+            continue
+        merged.extend(events)
+        findings = check_journal_conformance(events, machine)
+        for line in findings:
+            print(f"{run_dir}: {line}")
+        if findings:
+            rc = max(rc, 1)
+        else:
+            print(f"graftlint control: {run_dir}: {len(events)} events "
+                  f"replay conformant")
+    if args.coverage and merged:
+        for line in conformance_coverage(merged, machine):
+            print(f"warning: {line}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,8 @@
 """Shared golden-file plumbing for graftlint's budget layers.
 
-Layers 2 (``audit.py``), 3 (``sharding.py``), C (``concurrency.py``) and
-P (``perf.py``) all commit a JSON golden next to the lint package and
-verify against it with the same contract: ``--regen`` rewrites the file
+Layers 2 (``audit.py``), 3 (``sharding.py``), C (``concurrency.py``),
+P (``perf.py``) and S (``control.py``) all commit a JSON golden next to
+the lint package and verify against it with the same contract: ``--regen`` rewrites the file
 after an intentional change, ``--diff-out`` leaves a CI artifact on
 mismatch, and a schema tag plus provenance header make stale files fail
 loud instead of quietly passing. The first three grew that logic as
@@ -22,7 +22,7 @@ Two write paths, one atomicity story:
 
 :func:`regen_all_goldens` is the driver for the latter: it *measures*
 every layer first (the expensive, failure-prone part), then commits all
-four goldens in one batch — so a plan that fails to trace aborts the
+five goldens in one batch — so a plan that fails to trace aborts the
 whole regen with nothing rewritten.
 """
 
@@ -143,21 +143,28 @@ def regen_all_goldens(plans: Optional[Sequence[str]] = None,
                       shard_budgets_path: Optional[str] = None,
                       manifest_path: Optional[str] = None,
                       perf_budgets_path: Optional[str] = None,
+                      control_path: Optional[str] = None,
                       retrace_steps: int = 4,
                       ) -> Tuple[List[str], List[str]]:
     """Re-measure and rewrite EVERY layer's golden in one atomic batch.
 
-    Measurement order is cheap-to-expensive (manifest AST scan, Layer 2
-    traces, Layer 3 compiles, Layer P compiles + retrace execution); a
-    failure anywhere aborts before a single committed file changes.
-    Returns ``(errors, warnings)`` where errors are the layers' hard
-    invariants evaluated on the fresh measurements (a regen must not
-    mask e.g. an f32 scoring leak) and warnings list the written files.
+    Measurement order is cheap-to-expensive (Layer S control-plane
+    extraction, manifest AST scan, Layer 2 traces, Layer 3 compiles,
+    Layer P compiles + retrace execution); a failure anywhere aborts
+    before a single committed file changes. Returns
+    ``(errors, warnings)`` where errors are the layers' hard invariants
+    evaluated on the fresh measurements (a regen must not mask e.g. an
+    f32 scoring leak — or an oscillating ladder) and warnings list the
+    written files.
     """
     # Lazy layer imports: the layers import this module for their own
     # golden plumbing, so the dependency must point inward only at call
     # time.
-    from mercury_tpu.lint import audit, concurrency, perf, sharding
+    from mercury_tpu.lint import (audit, concurrency, control,
+                                  modelcheck, perf, sharding)
+
+    control_facts = control.extract_control_facts()
+    control_doc = control.control_doc(control_facts)
 
     audit.ensure_cpu_devices()
     plan_names = tuple(plans) if plans else audit.PLAN_NAMES
@@ -172,6 +179,8 @@ def regen_all_goldens(plans: Optional[Sequence[str]] = None,
                   for p in plan_names]
 
     errors: List[str] = []
+    errors.extend(control.check_extraction(control_facts))
+    errors.extend(modelcheck.check_invariants(control_doc["machine"]))
     for m in audit_ms:
         errors.extend(audit.check_invariants(m))
     errors.extend(sharding.check_axis_registry())
@@ -181,6 +190,7 @@ def regen_all_goldens(plans: Optional[Sequence[str]] = None,
         errors.extend(perf.check_perf_invariants(m))
 
     writes = [
+        (control_path or control.default_control_path(), control_doc),
         (manifest_path or concurrency.default_manifest_path(),
          manifest_doc),
         (budgets_path or audit.default_budgets_path(),

@@ -60,8 +60,10 @@ _BRACE_RE = re.compile(r"\{([^{}]+)\}")
 #: this, so the broad shape cannot false-positive on paths or metrics.
 EVENT_KIND_RE = re.compile(r"^[a-z0-9_]+/[a-z0-9_]+$")
 
-#: Files whose key literals are definitional, not emissions.
-_SKIP_FILES = frozenset({"registry.py"})
+#: Files whose key literals are definitional, not emissions: the
+#: registry itself and Layer S's control-plane model (``control.py``
+#: names journal kinds in its parent/rule tables, never emits them).
+_SKIP_FILES = frozenset({"registry.py", "control.py", "modelcheck.py"})
 
 
 def _repo_root() -> str:

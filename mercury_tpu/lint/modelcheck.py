@@ -1,0 +1,278 @@
+"""Exhaustive explorer for the Layer S control-plane machine.
+
+``lint/control.py`` extracts the supervisor's transition system into an
+explicit product graph (ladder level × restart-budget bucket × SLO latch
+set × probe-pin flag — a few dozen states, a few hundred edges). This
+module walks ALL of it and proves the six named invariants as hard lint
+gates; a controller that drives the ladder automatically (ROADMAP item
+3) lands behind these proofs:
+
+- **GLS01 uniform-absorbing** — the only edges that lower the ladder
+  are successful recovery probes, so under a persistent fault (no probe
+  can succeed) every level — uniform in particular — is absorbing.
+- **GLS02 recoverability** — every reachable state has a path to a
+  level-0 (async) state: no degraded corner is a dead end once the
+  fault clears and the latches release.
+- **GLS03 no-oscillation** — no cycle both recovers and re-breaches
+  without passing an SLO release: formally, any strongly connected
+  component containing a recover-emitting edge and a breach-emitting
+  edge must contain a release-emitting edge. The rising-edge latch
+  makes this structural (a breach flips a latch bit that only a release
+  flips back); remove the latch and this gate fails.
+- **GLS04 budget-monotone** — restart-budget buckets only move up their
+  order within an episode; the single sanctioned reset is the probe
+  climb into level 0 (full recovery).
+- **GLS05 journal-kind registry + parent closure** — every kind any
+  edge emits is in ``obs/registry.py::EVENT_KINDS``, and the per-kind
+  parent contract is closed and rooted: from any episode kind, walking
+  allowed parents reaches a root (a kind allowed to start a chain), so
+  every degrade episode forms one connected chain in the event DAG.
+- **GLS06 levels-step-by-one** — every edge changes the level by at
+  most one, and a degrade/recover emission implies exactly +1/-1.
+
+Stdlib-only, like the rest of the layer: the model check runs on the
+committed golden without jax, in the same CI job that verifies it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Set
+
+__all__ = ["check_invariants"]
+
+
+def _registered_kinds() -> Dict[str, str]:
+    from mercury_tpu.lint.metrics import load_event_registry
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return load_event_registry(os.path.join(root, "obs", "registry.py"))
+
+
+def _sccs(nodes: List[str],
+          adj: Dict[str, List[str]]) -> List[Set[str]]:
+    """Kosaraju strongly-connected components, iterative (the product
+    graph is small, but recursion limits are not a failure mode a lint
+    gate should have)."""
+    visited: Set[str] = set()
+    order: List[str] = []
+    for start in nodes:
+        if start in visited:
+            continue
+        stack = [(start, iter(adj.get(start, [])))]
+        visited.add(start)
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in visited:
+                    visited.add(nxt)
+                    stack.append((nxt, iter(adj.get(nxt, []))))
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                order.append(node)
+    radj: Dict[str, List[str]] = {}
+    for src, dsts in adj.items():
+        for dst in dsts:
+            radj.setdefault(dst, []).append(src)
+    comps: List[Set[str]] = []
+    assigned: Set[str] = set()
+    for start in reversed(order):
+        if start in assigned:
+            continue
+        comp = {start}
+        assigned.add(start)
+        stack2 = [start]
+        while stack2:
+            node = stack2.pop()
+            for prev in radj.get(node, []):
+                if prev not in assigned:
+                    assigned.add(prev)
+                    comp.add(prev)
+                    stack2.append(prev)
+        comps.append(comp)
+    return comps
+
+
+def check_invariants(machine: Dict[str, Any],
+                     registered: Optional[Dict[str, str]] = None,
+                     ) -> List[str]:
+    """BFS/SCC-explore the machine and return one error line per
+    violated invariant instance (empty = all six proved)."""
+    errors: List[str] = []
+    states: List[Dict[str, Any]] = machine.get("states", [])
+    edges: List[Dict[str, Any]] = machine.get("edges", [])
+    levels: List[str] = machine.get("levels", [])
+    buckets: List[str] = machine.get("buckets", [])
+    if not states or not edges or not levels:
+        return ["GLS00 control: machine is empty — extraction produced "
+                "no states/edges"]
+    ids = {s["id"] for s in states}
+    lv = {s["id"]: int(s["level"]) for s in states}
+    bk = {s["id"]: s["bucket"] for s in states}
+    border = {b: i for i, b in enumerate(buckets)}
+    if machine.get("initial") not in ids:
+        errors.append("GLS00 control: initial state "
+                      f"{machine.get('initial')!r} not in the state set")
+    dangling = [e for e in edges
+                if e["from"] not in ids or e["to"] not in ids]
+    for e in dangling[:5]:
+        errors.append(f"GLS00 control: edge {e['input']} references an "
+                      f"unknown state ({e['from']} -> {e['to']})")
+    if dangling:
+        return errors
+
+    deg_kinds = {k for k, r in machine.get("kind_rules", {}).items()
+                 if r.get("delta") == 1}
+    rec_kinds = {k for k, r in machine.get("kind_rules", {}).items()
+                 if r.get("delta") == -1}
+    breach_kinds = {k for k, r in machine.get("kind_rules", {}).items()
+                    if r.get("latch") in ("set", "none")
+                    and k.endswith("breach")}
+    release_kinds = {k for k, r in machine.get("kind_rules", {}).items()
+                     if r.get("latch") == "clear"}
+
+    # GLS01: only successful probes descend the ladder — uniform (and
+    # every level) is absorbing while the fault keeps probes failing.
+    for e in edges:
+        if lv[e["to"]] < lv[e["from"]] and e["input"] != "probe_ok":
+            errors.append(
+                f"GLS01 control: {e['input']} lowers the ladder "
+                f"({e['from']} -> {e['to']}) — only probe_ok may "
+                f"descend, so uniform stays absorbing under a "
+                f"persistent fault")
+
+    # GLS02: every reachable state can get back to async (level 0).
+    radj: Dict[str, List[str]] = {}
+    for e in edges:
+        radj.setdefault(e["to"], []).append(e["from"])
+    canreach = {s["id"] for s in states if lv[s["id"]] == 0}
+    frontier = list(canreach)
+    while frontier:
+        node = frontier.pop()
+        for prev in radj.get(node, []):
+            if prev not in canreach:
+                canreach.add(prev)
+                frontier.append(prev)
+    for s in states:
+        if s["id"] not in canreach:
+            errors.append(
+                f"GLS02 control: state {s['id']} has no path back to "
+                f"async — a degraded corner would be permanent even "
+                f"after the fault clears")
+
+    # GLS03: no recover→re-breach cycle without a latch release. The
+    # SCC form is sound: a breach edge inside an SCC flips a latch bit
+    # that only a release edge flips back, so a latched machine always
+    # carries the release inside the component; a latch-free machine
+    # (the oscillation fixture) has the recover+breach component with
+    # no release edge and fails here.
+    adj: Dict[str, List[str]] = {}
+    for e in edges:
+        adj.setdefault(e["from"], []).append(e["to"])
+    comp_of: Dict[str, int] = {}
+    comps = _sccs(sorted(ids), adj)
+    for i, comp in enumerate(comps):
+        for node in comp:
+            comp_of[node] = i
+    internal: Dict[int, Dict[str, bool]] = {}
+    for e in edges:
+        ci = comp_of[e["from"]]
+        if ci != comp_of[e["to"]]:
+            continue
+        slot = internal.setdefault(ci, {"recover": False,
+                                        "breach": False,
+                                        "release": False})
+        emits = set(e.get("emits", []))
+        if emits & rec_kinds:
+            slot["recover"] = True
+        if emits & breach_kinds:
+            slot["breach"] = True
+        if emits & release_kinds:
+            slot["release"] = True
+    for ci, slot in sorted(internal.items()):
+        if slot["recover"] and slot["breach"] and not slot["release"]:
+            sample = sorted(comps[ci])[:3]
+            errors.append(
+                f"GLS03 control: oscillation — a cycle through "
+                f"{sample} both recovers and re-breaches without an "
+                f"SLO release (the rising-edge latch is missing or "
+                f"bypassed)")
+
+    # GLS04: budget buckets are monotone within an episode; the only
+    # reset is the probe climb into level 0.
+    for e in edges:
+        if border.get(bk[e["to"]], 0) < border.get(bk[e["from"]], 0):
+            full_recovery = (e["input"] == "probe_ok"
+                             and lv[e["to"]] == 0
+                             and bk[e["to"]] == buckets[0])
+            if not full_recovery:
+                errors.append(
+                    f"GLS04 control: {e['input']} lowers the restart "
+                    f"bucket ({e['from']} -> {e['to']}) outside a full "
+                    f"recovery — budgets must be monotone within an "
+                    f"episode")
+
+    # GLS05: every emitted kind is registered, and the parent contract
+    # is closed + rooted so each episode is one connected chain.
+    if registered is None:
+        registered = _registered_kinds()
+    emitted: Set[str] = set()
+    for e in edges:
+        emitted.update(e.get("emits", []))
+    for kind in sorted(emitted - set(registered)):
+        errors.append(f"GLS05 control: edge-emitted kind {kind!r} is "
+                      f"not in obs/registry.py::EVENT_KINDS")
+    parents: Dict[str, List[Optional[str]]] = machine.get("parents", {})
+    for kind in sorted(emitted - set(parents)):
+        errors.append(f"GLS05 control: emitted kind {kind!r} has no "
+                      f"parent contract — its episode chain would be "
+                      f"disconnected")
+    for kind, allowed in sorted(parents.items()):
+        for p in allowed:
+            if p is not None and p not in parents:
+                errors.append(
+                    f"GLS05 control: {kind} allows parent {p!r} which "
+                    f"is not a modeled kind — the chain would dangle")
+    # Rootedness: walking allowed parents from any kind must reach a
+    # kind that may start a chain (None allowed) without dead-ending.
+    rooted: Set[str] = {k for k, allowed in parents.items()
+                        if None in allowed}
+    changed = True
+    while changed:
+        changed = False
+        for kind, allowed in parents.items():
+            if kind in rooted:
+                continue
+            if any(p in rooted for p in allowed if p is not None):
+                rooted.add(kind)
+                changed = True
+    for kind in sorted(set(parents) - rooted):
+        errors.append(
+            f"GLS05 control: {kind} cannot reach an episode root "
+            f"through its allowed parents — the degrade-episode chain "
+            f"is not connected")
+
+    # GLS06: levels change by ±1 only; a degrade/recover emission
+    # implies exactly that step.
+    for e in edges:
+        delta = lv[e["to"]] - lv[e["from"]]
+        if abs(delta) > 1:
+            errors.append(
+                f"GLS06 control: {e['input']} moves the ladder by "
+                f"{delta:+d} ({e['from']} -> {e['to']}) — levels "
+                f"change by one at a time")
+        emits = set(e.get("emits", []))
+        if emits & deg_kinds and delta != 1:
+            errors.append(
+                f"GLS06 control: {e['input']} emits a degrade but "
+                f"moves the ladder by {delta:+d} "
+                f"({e['from']} -> {e['to']})")
+        if emits & rec_kinds and delta != -1:
+            errors.append(
+                f"GLS06 control: {e['input']} emits a recover but "
+                f"moves the ladder by {delta:+d} "
+                f"({e['from']} -> {e['to']})")
+    return errors

@@ -204,6 +204,10 @@ METRIC_KEYS: Dict[str, str] = {
     "supervisor/units_down": "registered units currently failing liveness",
     "supervisor/slo_breaches":
         "cumulative registered-SLO breach events (rising edges)",
+    "supervisor/slo_latched":
+        "registered SLOs currently latched (breached and not released)",
+    "supervisor/probe_pinned":
+        "1 while a latched SLO pins the recovery probe, else 0",
     # checkpoint/* — durable checkpoint writer (train/checkpoint.py)
     "checkpoint/write_failures":
         "cumulative failed checkpoint write attempts (retries included)",

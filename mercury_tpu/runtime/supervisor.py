@@ -52,10 +52,19 @@ from mercury_tpu.utils.logging import get_logger
 
 _log = get_logger("mercury_tpu.runtime.supervisor")
 
-__all__ = ["HostSupervisor", "LEVEL_NAMES"]
+__all__ = ["HostSupervisor", "LEVEL_NAMES", "BUDGET_BUCKETS"]
 
 #: Degradation-ladder level names, index == level.
 LEVEL_NAMES = ("async", "sync", "frozen", "uniform")
+
+#: Restart-budget buckets of the graftlint Layer S model
+#: (``lint/control.py`` extracts this tuple; its order is the
+#: monotonicity order invariant GLS04 proves): ``fresh`` — no attempt
+#: consumed; ``partial`` — some budget used; ``spent`` — all budget
+#: used, exhaustion not yet handled; ``exhausted`` — the once-latch
+#: fired. :meth:`HostSupervisor.summary` reports the live bucket so
+#: ``/statusz`` shows the exact model-checker state.
+BUDGET_BUCKETS = ("fresh", "partial", "spent", "exhausted")
 
 
 class _Slo:
@@ -549,6 +558,7 @@ class HostSupervisor:
         with self._lock:
             down = sum(1 for u in self._units
                        if u.down_since_t is not None)
+            latched = sum(1 for s in self._slos if s.breached)
             return {
                 "supervisor/level": float(self._level),
                 "supervisor/restarts": float(self._restarts),
@@ -557,8 +567,56 @@ class HostSupervisor:
                 "supervisor/units_down": float(down),
                 "supervisor/slo_breaches": float(
                     sum(s.breaches for s in self._slos)),
+                "supervisor/slo_latched": float(latched),
+                "supervisor/probe_pinned": 1.0 if latched else 0.0,
                 "sampler/is_active": 0.0 if self._level >= 3 else 1.0,
             }
+
+    def _unit_bucket_locked(self, unit: _Unit) -> str:
+        """The Layer S budget bucket this unit's concrete counters map
+        to (caller holds the lock)."""
+        if unit.exhausted_handled:
+            return BUDGET_BUCKETS[3]
+        if unit.restarts_used > 0 and unit.restarts_used >= self._budget:
+            return BUDGET_BUCKETS[2]
+        if unit.restarts_used > 0:
+            return BUDGET_BUCKETS[1]
+        return BUDGET_BUCKETS[0]
+
+    def _model_state_locked(self) -> Dict[str, Any]:
+        """The live (level, budget bucket, latch set, pin) tuple in the
+        model checker's state space — ``state_id`` matches an id in the
+        committed ``lint/control_plane.json`` machine, so a /statusz
+        scrape names the exact state the GLS invariants were proved
+        over. The bucket is the worst (highest-order) escalating
+        unit's; latch slots are the model's ``slo{i}`` names in
+        registration order, real SLO names ride alongside."""
+        bucket = BUDGET_BUCKETS[0]
+        for u in self._units:  # graftlint: disable=GL120 -- lock-held helper: every caller (model_state, summary) wraps _model_state_locked() in `with self._lock`; taking the non-reentrant lock here would deadlock
+            if not u.escalates:
+                continue
+            b = self._unit_bucket_locked(u)
+            if BUDGET_BUCKETS.index(b) > BUDGET_BUCKETS.index(bucket):
+                bucket = b
+        latched = [s.name for s in self._slos if s.breached]
+        slots = [f"slo{i}" for i, s in enumerate(self._slos)
+                 if s.breached]
+        pinned = bool(latched)
+        latch = "+".join(slots) if slots else "none"
+        pin = "pinned" if pinned else "free"
+        return {
+            "level": self._level,
+            "level_name": LEVEL_NAMES[self._level],
+            "budget_bucket": bucket,
+            "latched_slos": latched,
+            "probe_pinned": pinned,
+            "state_id": f"L{self._level}/{bucket}/{latch}/{pin}",
+        }
+
+    def model_state(self) -> Dict[str, Any]:
+        """Public form of the model-checker state tuple."""
+        with self._lock:
+            return self._model_state_locked()
 
     def summary(self) -> Dict[str, Any]:
         """Cumulative view for flight-record context dumps."""
@@ -566,6 +624,7 @@ class HostSupervisor:
             return {
                 "level": self._level,
                 "level_name": LEVEL_NAMES[self._level],
+                "model_state": self._model_state_locked(),
                 "restart_budget": self._budget,
                 "restarts": self._restarts,
                 "degradations": self._degradations,

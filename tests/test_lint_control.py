@@ -1,0 +1,529 @@
+"""graftlint Layer S: control-plane extraction, model checking, golden
+parity, and journal-conformance replay.
+
+Three seeded-violation fixtures prove the gates bite: a level-skipping
+degrade (GLS10), an unjournaled restart path (GLS11), and a latch-free
+supervisor whose machine oscillates (GLS03). The conformance half is
+exercised both on synthetic journals (each invariant violated on
+purpose) and on a real HostSupervisor episode recorded through a real
+EventJournal — which must replay with zero findings against the
+committed ``lint/control_plane.json``.
+"""
+
+import json
+import os
+
+import pytest
+
+from mercury_tpu.lint import control, golden, modelcheck
+from mercury_tpu.obs.events import EventJournal, load_events
+from mercury_tpu.runtime.supervisor import (
+    BUDGET_BUCKETS,
+    LEVEL_NAMES,
+    HostSupervisor,
+)
+
+# --------------------------------------------------------------------------
+# fixtures: the real supervisor source, plus seeded mutations of it
+# --------------------------------------------------------------------------
+
+
+def _real_supervisor_source() -> str:
+    root = os.path.dirname(control.__file__)
+    path = os.path.join(os.path.dirname(root),
+                        *control.CONTROL_MODULES["supervisor"].split("/"))
+    with open(path) as f:
+        return f.read()
+
+
+def _mutate_method(src: str, method: str, old: str, new: str) -> str:
+    """Apply a textual replacement confined to one method body."""
+    start = src.index(f"def {method}")
+    end = src.index("\n    def ", start + 1)
+    body = src[start:end]
+    assert old in body, f"fixture anchor {old!r} missing from {method}"
+    return src[:start] + body.replace(old, new) + src[end:]
+
+
+def level_skip_source() -> str:
+    """Seeded violation: _degrade jumps TWO levels per decision."""
+    return _mutate_method(_real_supervisor_source(), "_degrade",
+                          "self._level = src + 1",
+                          "self._level = src + 2")
+
+
+def unjournaled_restart_source() -> str:
+    """Seeded violation: _try_restart journals nothing (both the
+    success and the failure emit are renamed off the journal API)."""
+    return _mutate_method(_real_supervisor_source(), "_try_restart",
+                          "self._journal_emit(",
+                          "self._offline_note(")
+
+
+def latch_free_source() -> str:
+    """Seeded violation: SLO breaches no longer latch and the probe is
+    never pinned — the machine can recover and re-breach forever with
+    no release edge (the oscillation GLS03 forbids)."""
+    src = _mutate_method(_real_supervisor_source(), "_check_slos",
+                         "slo.breached = status is not None",
+                         "pass  # latch removed")
+    return _mutate_method(src, "_maybe_probe",
+                          "slo_pinned = any(s.breached "
+                          "for s in self._slos)",
+                          "slo_pinned = False")
+
+
+def _machine():
+    return control.build_machine(control.extract_control_facts())
+
+
+def ev(kind, eid, parent=None, host=0, step=0, **detail):
+    return {"kind": kind, "event_id": eid, "parent_id": parent,
+            "host": host, "step": step, "detail": detail}
+
+
+# --------------------------------------------------------------------------
+# extraction on HEAD
+# --------------------------------------------------------------------------
+
+
+class TestExtraction:
+    def test_facts_match_runtime_constants(self):
+        facts = control.extract_control_facts()
+        assert facts["levels"] == list(LEVEL_NAMES)
+        assert facts["buckets"] == list(BUDGET_BUCKETS)
+
+    def test_ladder_moves_one_level_with_guards(self):
+        facts = control.extract_control_facts()
+        assert facts["degrade"]["delta"] == 1
+        assert facts["recover"]["delta"] == -1
+        assert facts["degrade"]["absorbing_guard"]
+        assert facts["recover"]["floor_guard"]
+        assert facts["recover"]["budget_reset_on_full_recovery"]
+
+    def test_every_transition_site_journals(self):
+        facts = control.extract_control_facts()
+        for site, kinds in facts["transition_sites"].items():
+            assert kinds, f"{site} journals nothing"
+        assert "supervisor/degrade" in facts["degrade"]["emits"]
+        assert "supervisor/recover" in facts["recover"]["emits"]
+
+    def test_slo_latch_and_probe_pin_extracted(self):
+        facts = control.extract_control_facts()
+        assert facts["slo"]["latched"]
+        assert facts["slo"]["breach_degrades"]
+        assert facts["probe"]["pinned_by_latched_slo"]
+        assert facts["probe"]["ok_recovers"]
+        assert facts["exhaustion"]["once_latched"]
+        assert facts["restart"]["consumes_budget_on_attempt"]
+
+    def test_head_extraction_has_no_findings(self):
+        facts = control.extract_control_facts()
+        assert control.check_extraction(facts) == []
+
+    def test_fault_kinds_and_triggers_populate_alphabet(self):
+        facts = control.extract_control_facts()
+        assert "scorer_die" in facts["fault_kinds"]
+        assert facts["anomaly_triggers"]
+
+
+class TestSeededFixtures:
+    """Each planted control-plane bug must be caught by name."""
+
+    def test_level_skipping_degrade_caught(self):
+        facts = control.extract_control_facts(
+            sources={"supervisor": level_skip_source()})
+        errors = control.check_extraction(facts)
+        assert any("GLS10" in e and "_degrade" in e for e in errors), errors
+
+    def test_unjournaled_restart_caught(self):
+        facts = control.extract_control_facts(
+            sources={"supervisor": unjournaled_restart_source()})
+        errors = control.check_extraction(facts)
+        assert any("GLS11" in e and "_try_restart" in e
+                   for e in errors), errors
+
+    def test_latch_free_oscillation_caught_by_model_checker(self):
+        facts = control.extract_control_facts(
+            sources={"supervisor": latch_free_source()})
+        machine = control.build_machine(facts)
+        errors = modelcheck.check_invariants(machine)
+        assert any("GLS03" in e for e in errors), errors
+
+
+# --------------------------------------------------------------------------
+# machine construction + invariants on HEAD
+# --------------------------------------------------------------------------
+
+
+class TestMachine:
+    def test_machine_well_formed(self):
+        m = _machine()
+        ids = {s["id"] for s in m["states"]}
+        assert m["initial"] in ids
+        assert m["states"] and m["edges"]
+        for e in m["edges"]:
+            assert e["from"] in ids and e["to"] in ids
+
+    def test_state_space_is_the_full_reachable_product(self):
+        m = _machine()
+        # level 0 never latches a pin-free probe state with a latch set?
+        # No: breaches latch at any level, so latched level-0 states exist.
+        levels = {s["level"] for s in m["states"]}
+        assert levels == set(range(len(LEVEL_NAMES)))
+        assert {s["bucket"] for s in m["states"]} <= set(BUDGET_BUCKETS)
+
+    def test_invariants_hold_on_head(self):
+        assert modelcheck.check_invariants(_machine()) == []
+
+    def test_every_edge_emit_is_registered(self):
+        m = _machine()
+        modeled = set(m["kind_rules"])
+        for e in m["edges"]:
+            for k in e["emits"]:
+                assert k in modeled
+
+    def test_absorbing_top_emits_nothing_on_further_degrade(self):
+        # _degrade's guard returns before journaling at the top level:
+        # breach/exhaustion edges from uniform emit only their own kind.
+        m = _machine()
+        top = len(LEVEL_NAMES) - 1
+        lv = {s["id"]: s["level"] for s in m["states"]}
+        for e in m["edges"]:
+            if lv[e["from"]] == top:
+                assert "supervisor/degrade" not in e["emits"], e
+
+
+# --------------------------------------------------------------------------
+# golden parity (--layer control contract)
+# --------------------------------------------------------------------------
+
+
+class TestGoldenParity:
+    def test_head_verifies_against_committed_golden(self):
+        errors, warnings = control.run_control_check()
+        assert errors == [], "\n".join(errors + warnings)
+
+    def test_missing_golden_raises_file_not_found(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            control.run_control_check(
+                control_path=str(tmp_path / "missing.json"))
+
+    def test_tampered_golden_diffs_and_writes_artifact(self, tmp_path):
+        doc = golden.load_golden(control.default_control_path(),
+                                 control.CONTROL_SCHEMA,
+                                 control.REGEN_HINT)
+        doc["facts"]["levels"] = ["async", "uniform"]
+        tampered = tmp_path / "control_plane.json"
+        tampered.write_text(json.dumps(doc))
+        out = tmp_path / "diff.txt"
+        errors, _ = control.run_control_check(
+            control_path=str(tampered), diff_out=str(out))
+        assert any("drifted" in e for e in errors)
+        assert "facts.levels" in out.read_text()
+
+    def test_regen_writes_byte_stable_golden(self, tmp_path):
+        p = tmp_path / "control_plane.json"
+        control.run_control_check(control_path=str(p), regen=True)
+        first = p.read_text()
+        control.run_control_check(control_path=str(p), regen=True)
+        assert p.read_text() == first
+        assert json.loads(first)["schema"] == control.CONTROL_SCHEMA
+
+    def test_all_or_nothing_across_six_goldens(self, tmp_path):
+        """Satellite: a partial failure across the whole golden set must
+        rewrite nothing — stage all six, fail the last, diff none."""
+        paths = [tmp_path / f"g{i}.json" for i in range(6)]
+        for i, p in enumerate(paths):
+            p.write_text(json.dumps({"old": i}))
+        writes = [(str(p), {"new": i}) for i, p in enumerate(paths[:-1])]
+        writes.append((str(paths[-1]), {"bad": object()}))
+        with pytest.raises(TypeError):
+            golden.commit_goldens(writes)
+        for i, p in enumerate(paths):
+            assert json.loads(p.read_text()) == {"old": i}
+        assert list(tmp_path.glob("*.tmp")) == []
+
+    def test_regen_all_goldens_includes_control_plane(self, tmp_path,
+                                                      monkeypatch):
+        """The one-stop --regen commits control_plane.json in the same
+        transaction as the other goldens (layer measurement stubbed —
+        the tracing layers have their own tests)."""
+        from mercury_tpu.lint import audit, concurrency, perf, sharding
+
+        monkeypatch.setattr(audit, "PLAN_NAMES", ())
+        monkeypatch.setattr(audit, "ensure_cpu_devices", lambda: None)
+        monkeypatch.setattr(sharding, "check_axis_registry", lambda: [])
+        monkeypatch.setattr(concurrency, "extract_manifest",
+                            lambda paths: {"schema": "stub"})
+        monkeypatch.setattr(audit, "budgets_doc", lambda ms: {"s": 1})
+        monkeypatch.setattr(sharding, "shard_budgets_doc",
+                            lambda ms: {"s": 1})
+        monkeypatch.setattr(perf, "perf_budgets_doc",
+                            lambda ms, rs: {"s": 1})
+        ctrl = tmp_path / "control_plane.json"
+        errors, warnings = golden.regen_all_goldens(
+            budgets_path=str(tmp_path / "budgets.json"),
+            shard_budgets_path=str(tmp_path / "shard.json"),
+            manifest_path=str(tmp_path / "threads.json"),
+            perf_budgets_path=str(tmp_path / "perf.json"),
+            control_path=str(ctrl))
+        assert errors == []
+        doc = json.loads(ctrl.read_text())
+        assert doc["schema"] == control.CONTROL_SCHEMA
+        assert any("control_plane.json" in w for w in warnings)
+
+
+# --------------------------------------------------------------------------
+# journal conformance replay: synthetic journals
+# --------------------------------------------------------------------------
+
+
+class TestConformanceSynthetic:
+    def test_clean_episode_replays_conformant(self):
+        events = [
+            ev("supervisor/slo_breach", "e1", slo="scorer_service",
+               status="stale"),
+            ev("supervisor/degrade", "e2", parent="e1",
+               **{"from": "async", "to": "sync"}),
+            ev("supervisor/slo_release", "e3", parent="e1",
+               slo="scorer_service"),
+            ev("supervisor/probe_ok", "e4", parent="e2", level=1),
+            ev("supervisor/recover", "e5", parent="e4",
+               **{"from": "sync", "to": "async"}),
+        ]
+        assert control.check_journal_conformance(events, _machine()) == []
+
+    def test_level_skipping_degrade_flagged(self):
+        events = [ev("supervisor/degrade", "e1",
+                     **{"from": "async", "to": "frozen"})]
+        findings = control.check_journal_conformance(events, _machine())
+        assert any("skips levels" in f for f in findings)
+
+    def test_recover_while_slo_latched_flagged(self):
+        """The oscillation guard: a recover with a breach still latched
+        (no release in between) is exactly what GLS03 forbids."""
+        events = [
+            ev("supervisor/slo_breach", "e1", slo="x", status="bad"),
+            ev("supervisor/degrade", "e2", parent="e1",
+               **{"from": "async", "to": "sync"}),
+            ev("supervisor/probe_ok", "e3", parent="e2", level=1),
+            ev("supervisor/recover", "e4", parent="e3",
+               **{"from": "sync", "to": "async"}),
+        ]
+        findings = control.check_journal_conformance(events, _machine())
+        assert any("latched" in f for f in findings)
+
+    def test_unjournaled_transition_flagged(self):
+        events = [
+            ev("supervisor/degrade", "e1",
+               **{"from": "async", "to": "sync"}),
+            ev("supervisor/degrade", "e2",
+               **{"from": "frozen", "to": "uniform"}),
+        ]
+        findings = control.check_journal_conformance(events, _machine())
+        assert any("was not journaled" in f for f in findings)
+
+    def test_rebreach_without_release_flagged(self):
+        events = [
+            ev("supervisor/slo_breach", "e1", slo="x", status="bad"),
+            ev("supervisor/slo_breach", "e2", slo="x", status="bad"),
+        ]
+        findings = control.check_journal_conformance(events, _machine())
+        assert any("re-breach" in f for f in findings)
+
+    def test_restart_after_exhaustion_flagged(self):
+        events = [
+            ev("supervisor/restart_failed", "e1", unit="s", attempt=1,
+               budget=1),
+            ev("supervisor/exhausted", "e2", parent="e1", unit="s",
+               budget=1),
+            ev("supervisor/restart", "e3", unit="s", attempt=2,
+               budget=1),
+        ]
+        findings = control.check_journal_conformance(events, _machine())
+        assert any("after exhaustion" in f for f in findings)
+
+    def test_bad_parent_chain_flagged(self):
+        events = [
+            ev("supervisor/restart", "e1", unit="s", attempt=1, budget=3),
+            ev("supervisor/exhausted", "e2", parent="e1", unit="s",
+               budget=3),
+        ]
+        findings = control.check_journal_conformance(events, _machine())
+        assert any("parented to" in f for f in findings)
+
+    def test_hosts_replay_independently(self):
+        events = [
+            ev("supervisor/degrade", "a1", host=0,
+               **{"from": "async", "to": "sync"}),
+            ev("supervisor/degrade", "b1", host=1,
+               **{"from": "async", "to": "sync"}),
+        ]
+        assert control.check_journal_conformance(events, _machine()) == []
+
+    def test_ambient_kinds_pass_through(self):
+        events = [
+            ev("fault/fired", "e1", kind_name="scorer_die"),
+            ev("anomaly/triggered", "e2", trigger="is_losing"),
+        ]
+        assert control.check_journal_conformance(events, _machine()) == []
+
+    def test_coverage_names_unobserved_transitions(self):
+        events = [ev("supervisor/degrade", "e1",
+                     **{"from": "async", "to": "sync"})]
+        gaps = control.conformance_coverage(events, _machine())
+        assert any("supervisor/recover" in g for g in gaps)
+        assert any("never observed from level" in g for g in gaps)
+
+
+# --------------------------------------------------------------------------
+# journal conformance: rotation / torn shards (satellite d)
+# --------------------------------------------------------------------------
+
+
+class TestConformanceRotation:
+    FULL = [
+        ev("supervisor/slo_breach", "e1", slo="x", status="bad"),
+        ev("supervisor/degrade", "e2", parent="e1",
+           **{"from": "async", "to": "sync"}),
+        ev("supervisor/slo_release", "e3", parent="e1", slo="x"),
+        ev("supervisor/probe_ok", "e4", parent="e2", level=1),
+        ev("supervisor/recover", "e5", parent="e4",
+           **{"from": "sync", "to": "async"}),
+    ]
+
+    def test_every_rotation_suffix_replays_clean(self):
+        """A rotated shard is a suffix of a valid run: state binds from
+        the first event that declares it, so no suffix may produce a
+        false violation."""
+        m = _machine()
+        for start in range(len(self.FULL)):
+            findings = control.check_journal_conformance(
+                self.FULL[start:], m)
+            assert findings == [], (start, findings)
+
+    def test_torn_final_line_replays_clean(self, tmp_path):
+        j = EventJournal(str(tmp_path), host=0)
+        j.emit("supervisor/slo_breach", 1,
+               detail={"slo": "x", "status": "bad"})
+        j.emit("supervisor/degrade", 1,
+               detail={"from": "async", "to": "sync"})
+        j.close()
+        shard = tmp_path / "events.h0.jsonl"
+        with open(shard, "a") as f:
+            f.write('{"schema": "torn mid-wri')  # crash mid-append
+        events = load_events(str(tmp_path))
+        assert len(events) == 2
+        assert control.check_journal_conformance(events, _machine()) == []
+
+
+# --------------------------------------------------------------------------
+# end to end: a real supervisor episode through a real journal
+# --------------------------------------------------------------------------
+
+
+class TestConformanceIntegration:
+    def test_real_episode_replays_conformant(self, tmp_path):
+        """Drive a real HostSupervisor through breach -> degrade ->
+        release -> probe -> recover with journaling on; the recorded
+        shard must replay conformant against the committed machine."""
+        journal = EventJournal(str(tmp_path), host=0)
+        sup = HostSupervisor(restart_budget=3, backoff_s=0.0,
+                             probe_every=1, poll_s=0.0, journal=journal)
+        breaching = [True]
+        sup.register_slo("scorer_service",
+                         lambda: "stale" if breaching[0] else None)
+        sup.set_ladder(probe=lambda: None, revive=lambda: None)
+
+        sup.tick(1)                    # rising edge: breach + degrade
+        assert sup.level() == 1
+        sup.tick(2)                    # latched: probe pinned, no climb
+        assert sup.level() == 1
+        breaching[0] = False
+        sup.tick(3)                    # falling edge: release
+        sup.tick(4)                    # probe_ok -> recover
+        assert sup.level() == 0
+        journal.close()
+
+        events = load_events(str(tmp_path))
+        kinds = [e["kind"] for e in events]
+        assert "supervisor/slo_breach" in kinds
+        assert "supervisor/recover" in kinds
+        assert control.check_journal_conformance(events) == []
+
+    def test_exhaustion_episode_replays_conformant(self, tmp_path):
+        """Budget-0 exhaustion (the chaos smoke's config): the unit
+        exhausts with zero attempts and the ladder escalates."""
+        journal = EventJournal(str(tmp_path), host=0)
+        sup = HostSupervisor(restart_budget=0, backoff_s=0.0,
+                             probe_every=0, poll_s=0.0, journal=journal)
+        sup.register_unit("scorer", alive=lambda: False,
+                          restart=lambda: None, escalates=True)
+        sup.tick(1)
+        assert sup.level() == 1
+        journal.close()
+        events = load_events(str(tmp_path))
+        assert "supervisor/exhausted" in [e["kind"] for e in events]
+        assert control.check_journal_conformance(events) == []
+
+    def test_cli_replay_and_empty_dir(self, tmp_path, capsys):
+        run = tmp_path / "run"
+        run.mkdir()
+        journal = EventJournal(str(run), host=0)
+        journal.emit("supervisor/degrade", 1,
+                     detail={"from": "async", "to": "sync"})
+        journal.close()
+        assert control.main([str(run), "--coverage"]) == 0
+        out = capsys.readouterr().out
+        assert "replay conformant" in out
+        assert "warning: coverage:" in out
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert control.main([str(empty)]) == 2
+
+
+# --------------------------------------------------------------------------
+# supervisor model-state surface (satellite c)
+# --------------------------------------------------------------------------
+
+
+class TestModelStateSurface:
+    def _machine_ids(self):
+        return {s["id"] for s in _machine()["states"]}
+
+    def test_initial_state_id_is_machine_initial(self):
+        sup = HostSupervisor(restart_budget=3, backoff_s=0.0,
+                             probe_every=0, poll_s=0.0)
+        ms = sup.model_state()
+        assert ms["state_id"] == _machine()["initial"]
+
+    def test_live_state_ids_stay_inside_machine(self):
+        sup = HostSupervisor(restart_budget=1, backoff_s=0.0,
+                             probe_every=0, poll_s=0.0)
+        breaching = [False]
+        sup.register_slo("scorer_service",
+                         lambda: "bad" if breaching[0] else None)
+        sup.register_unit("scorer", alive=lambda: False,
+                          restart=lambda: (_ for _ in ()).throw(
+                               RuntimeError("down")),
+                          escalates=True)
+        ids = self._machine_ids()
+        assert sup.model_state()["state_id"] in ids
+        breaching[0] = True
+        for step in range(1, 5):
+            sup.tick(step)
+            ms = sup.model_state()
+            assert ms["state_id"] in ids, ms
+        assert sup.model_state()["probe_pinned"] is True
+        assert sup.model_state()["latched_slos"] == ["scorer_service"]
+
+    def test_stats_and_summary_expose_model_state(self):
+        sup = HostSupervisor(restart_budget=3, backoff_s=0.0,
+                             probe_every=0, poll_s=0.0)
+        stats = sup.stats()
+        assert stats["supervisor/slo_latched"] == 0.0
+        assert stats["supervisor/probe_pinned"] == 0.0
+        summary = sup.summary()
+        assert summary["model_state"]["state_id"] == _machine()["initial"]
+        assert summary["model_state"]["budget_bucket"] == BUDGET_BUCKETS[0]
