@@ -29,13 +29,20 @@ Layer selection:
   against the committed ``lint/control_plane.json`` (``--regen``
   parity; the journal-conformance replay half is
   ``python -m mercury_tpu.lint.control RUN_DIR``). Pure stdlib.
+- ``--layer state``: Layer E — extract the MercuryState schema (fields,
+  shape-roles, elastic policies, checkpoint lineage + upgrade shims,
+  carry sites), gate the GLE01–GLE06 invariants, and verify against the
+  committed ``lint/state_schema.json`` (``--regen`` parity; the
+  differential reshard-conformance half is
+  ``python -m mercury_tpu.lint.state --differential``). Pure stdlib.
 - ``--layer all``: all of the above. With ``--diff-out PATH`` the audit
   diff goes to ``PATH``, the sharding diff to ``PATH.sharding``, the
   thread-manifest diff to ``PATH.threads``, the perf diff to
-  ``PATH.perf``, and the control-plane diff to ``PATH.control``.
+  ``PATH.perf``, the control-plane diff to ``PATH.control``, and the
+  state-schema diff to ``PATH.state``.
 
 ``--regen`` with the default ``--layer ast`` (or ``--layer all``) is the
-one-stop regen: it re-measures EVERY budget layer and rewrites all five
+one-stop regen: it re-measures EVERY budget layer and rewrites all six
 goldens atomically — either every file updates or none does (a plan that
 fails mid-measure cannot leave a half-regenerated set).
 
@@ -72,14 +79,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "sharding & memory auditor (Layer 3) + "
                     "host-concurrency auditor (Layer C) + "
                     "cost/roofline & retrace auditor (Layer P) + "
-                    "control-plane model checker (Layer S)",
+                    "control-plane model checker (Layer S) + "
+                    "state-schema conformance checker (Layer E)",
     )
     ap.add_argument("paths", nargs="*",
                     help="files/directories for Layer 1 (default: the "
                          "mercury_tpu package)")
     ap.add_argument("--layer",
                     choices=("ast", "metrics", "audit", "sharding",
-                             "concurrency", "perf", "control", "all"),
+                             "concurrency", "perf", "control", "state",
+                             "all"),
                     default="ast")
     ap.add_argument("--select", action="append", default=None,
                     metavar="RULE",
@@ -108,6 +117,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--control-plane", default=None, metavar="PATH",
                     help="Layer S control_plane.json to verify against "
                          "/ regenerate")
+    ap.add_argument("--state-schema", default=None, metavar="PATH",
+                    help="Layer E state_schema.json to verify against "
+                         "/ regenerate")
     ap.add_argument("--regen", action="store_true",
                     help="re-measure and WRITE the budget file(s) instead "
                          "of verifying (review the diff before committing)")
@@ -127,7 +139,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.regen and args.layer in ("ast", "all"):
         # One-stop atomic regen: re-measure every budget layer, then
-        # commit all five goldens in a single all-or-nothing batch
+        # commit all six goldens in a single all-or-nothing batch
         # (lint/golden.py::regen_all_goldens). Any measurement or
         # invariant failure aborts before a single committed file moves.
         from mercury_tpu.lint import golden
@@ -149,7 +161,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 shard_budgets_path=args.shard_budgets,
                 manifest_path=args.thread_manifest,
                 perf_budgets_path=args.perf_budgets,
-                control_path=args.control_plane)
+                control_path=args.control_plane,
+                state_schema_path=args.state_schema)
         except Exception as exc:  # nothing was committed — say so
             print(f"graftlint regen: aborted with no golden rewritten "
                   f"({type(exc).__name__}: {exc})", file=sys.stderr)
@@ -235,6 +248,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("graftlint control: machine verified against "
                       "lint/control_plane.json; invariants "
                       "GLS01-GLS06 hold")
+        if errors:
+            rc = 1
+
+    if args.layer in ("state", "all"):
+        from mercury_tpu.lint import state as state_lint
+
+        diff_out = args.diff_out
+        if diff_out and args.layer == "all":
+            diff_out = diff_out + ".state"
+        try:
+            errors, warnings = state_lint.run_state_check(
+                state_schema_path=args.state_schema,
+                regen=args.regen, diff_out=diff_out)
+        except FileNotFoundError as exc:
+            print(f"graftlint state: state schema missing ({exc}) — "
+                  f"run with --layer state --regen first",
+                  file=sys.stderr)
+            return 2
+        except (OSError, ValueError) as exc:
+            print(f"graftlint state: {exc}", file=sys.stderr)
+            return 2
+        if args.as_json:
+            collect("state", errors, warnings)
+        else:
+            for line in warnings:
+                print(f"warning: {line}")
+            for line in errors:
+                print(line)
+            if not errors:
+                print("graftlint state: schema verified against "
+                      "lint/state_schema.json; invariants "
+                      "GLE01-GLE06 hold")
         if errors:
             rc = 1
 

@@ -1,7 +1,8 @@
 """Shared golden-file plumbing for graftlint's budget layers.
 
 Layers 2 (``audit.py``), 3 (``sharding.py``), C (``concurrency.py``),
-P (``perf.py``) and S (``control.py``) all commit a JSON golden next to
+P (``perf.py``), S (``control.py``) and E (``state.py``) all commit a
+JSON golden next to
 the lint package and verify against it with the same contract: ``--regen`` rewrites the file
 after an intentional change, ``--diff-out`` leaves a CI artifact on
 mismatch, and a schema tag plus provenance header make stale files fail
@@ -22,7 +23,7 @@ Two write paths, one atomicity story:
 
 :func:`regen_all_goldens` is the driver for the latter: it *measures*
 every layer first (the expensive, failure-prone part), then commits all
-five goldens in one batch — so a plan that fails to trace aborts the
+six goldens in one batch — so a plan that fails to trace aborts the
 whole regen with nothing rewritten.
 """
 
@@ -144,25 +145,29 @@ def regen_all_goldens(plans: Optional[Sequence[str]] = None,
                       manifest_path: Optional[str] = None,
                       perf_budgets_path: Optional[str] = None,
                       control_path: Optional[str] = None,
+                      state_schema_path: Optional[str] = None,
                       retrace_steps: int = 4,
                       ) -> Tuple[List[str], List[str]]:
     """Re-measure and rewrite EVERY layer's golden in one atomic batch.
 
-    Measurement order is cheap-to-expensive (Layer S control-plane
-    extraction, manifest AST scan, Layer 2 traces, Layer 3 compiles,
-    Layer P compiles + retrace execution); a failure anywhere aborts
-    before a single committed file changes. Returns
-    ``(errors, warnings)`` where errors are the layers' hard invariants
-    evaluated on the fresh measurements (a regen must not mask e.g. an
-    f32 scoring leak — or an oscillating ladder) and warnings list the
-    written files.
+    Measurement order is cheap-to-expensive (Layer E state-schema and
+    Layer S control-plane extraction, manifest AST scan, Layer 2
+    traces, Layer 3 compiles, Layer P compiles + retrace execution); a
+    failure anywhere aborts before a single committed file changes.
+    Returns ``(errors, warnings)`` where errors are the layers' hard
+    invariants evaluated on the fresh measurements (a regen must not
+    mask e.g. an f32 scoring leak — or an oscillating ladder) and
+    warnings list the written files.
     """
     # Lazy layer imports: the layers import this module for their own
     # golden plumbing, so the dependency must point inward only at call
     # time.
     from mercury_tpu.lint import (audit, concurrency, control,
                                   modelcheck, perf, sharding)
+    from mercury_tpu.lint import state as state_lint
 
+    state_facts = state_lint.extract_state_facts()
+    state_doc = state_lint.state_doc(state_facts)
     control_facts = control.extract_control_facts()
     control_doc = control.control_doc(control_facts)
 
@@ -179,6 +184,7 @@ def regen_all_goldens(plans: Optional[Sequence[str]] = None,
                   for p in plan_names]
 
     errors: List[str] = []
+    errors.extend(state_lint.check_extraction(state_facts))
     errors.extend(control.check_extraction(control_facts))
     errors.extend(modelcheck.check_invariants(control_doc["machine"]))
     for m in audit_ms:
@@ -190,6 +196,8 @@ def regen_all_goldens(plans: Optional[Sequence[str]] = None,
         errors.extend(perf.check_perf_invariants(m))
 
     writes = [
+        (state_schema_path or state_lint.default_state_schema_path(),
+         state_doc),
         (control_path or control.default_control_path(), control_doc),
         (manifest_path or concurrency.default_manifest_path(),
          manifest_doc),

@@ -253,6 +253,8 @@ EVENT_KINDS: Dict[str, str] = {
     "checkpoint/verified": "a generation passed manifest verification",
     "checkpoint/fallback":
         "restore rejected a generation and fell back to an older one",
+    "checkpoint/schema_drift":
+        "a restored manifest's state_schema_sha differs from HEAD's",
     # anomaly/* — flight recorder (obs/anomaly.py)
     "anomaly/triggered":
         "an anomaly trigger fired; detail carries the flight-record path",

@@ -305,6 +305,45 @@ def _walk_label(evt: Dict[str, Any]) -> str:
     return f"{kind}@{step}" if isinstance(step, int) and step >= 0 else kind
 
 
+def _elastic_history_blocks(events: List[Dict[str, Any]]) -> List[Block]:
+    """The "Elastic history" section: one row per reshard, pairing each
+    ``elastic/reshard_begin`` with its ``elastic/reshard_end`` (matched
+    by ``parent_id``) — old/new mesh, the carried fields, wall-clock
+    duration, and the state-schema sha the restoring build was linted
+    against (so a post-resume trajectory shift can be tied to a schema
+    change, not just a topology one)."""
+    begins = [e for e in events if e.get("kind") == "elastic/reshard_begin"]
+    if not begins:
+        return []
+    ends_by_parent = {e.get("parent_id"): e for e in events
+                      if e.get("kind") == "elastic/reshard_end"
+                      and e.get("parent_id")}
+    blocks: List[Block] = [("h", 2, "Elastic history")]
+    blocks.append(("p", f"{len(begins)} reshard(s) recorded in the "
+                   "event journal"))
+    rows = []
+    for b in begins:
+        d = b.get("detail") or {}
+        end = ends_by_parent.get(b.get("event_id"))
+        mesh = (f"W {d.get('w_old', '?')}→{d.get('w_new', '?')}, "
+                f"L {d.get('l_old', '?')}→{d.get('l_new', '?')}")
+        if end is not None and isinstance(end.get("wall_s"), (int, float)) \
+                and isinstance(b.get("wall_s"), (int, float)):
+            wall = f"{end['wall_s'] - b['wall_s']:.2f}s"
+        else:
+            wall = "incomplete" if end is None else "—"
+        carried = ((end.get("detail") or {}).get("carried")
+                   if end is not None else None)
+        sha = d.get("state_schema_sha")
+        rows.append([b.get("step", "—"), mesh,
+                     ", ".join(carried) if carried else "—", wall,
+                     (str(sha)[:12] if sha else "—")])
+    blocks.append(("table",
+                   ["step", "mesh", "carried fields", "wall-clock",
+                    "schema sha"], rows))
+    return blocks
+
+
 def _event_timeline_blocks(events: List[Dict[str, Any]]) -> List[Block]:
     """The "Run timeline" section from the control-plane event journal:
     a kind census, the causal DAG's linked events, and one reconstructed
@@ -451,6 +490,7 @@ def _run_blocks(run: Dict[str, Any]) -> List[Block]:
         blocks.append(("kv", [
             ("h2d overlap", f"{bd['h2d']['overlap_frac']:.2%}"),
             ("idle fraction", f"{bd['idle']['idle_frac']:.2%}")]))
+    blocks.extend(_elastic_history_blocks(run["events"]))
     blocks.extend(_event_timeline_blocks(run["events"]))
     summary = run.get("supervisor_summary")
     if isinstance(summary, dict):

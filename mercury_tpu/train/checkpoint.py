@@ -224,6 +224,116 @@ def _sweep_stale_tmps(directory: str, min_age_secs: float = 300.0) -> None:
         pass
 
 
+# --------------------------------------------------------------------------
+# state-schema lineage + upgrade shims (graftlint Layer E contract)
+# --------------------------------------------------------------------------
+
+#: Ordered history of the on-disk ``MercuryState`` schema: each entry is
+#: ``(version, fields_added)``. A PURE literal — graftlint Layer E
+#: (``lint/state.py``) parses it with ``ast.literal_eval`` and checks
+#: (GLE04) that every consecutive pair has an upgrade shim, so every
+#: committed checkpoint vintage can reach HEAD. Append-only: a new
+#: ``MercuryState`` field means a new version here plus a shim below.
+STATE_SCHEMA_LINEAGE = (
+    ("v1", ()),
+    ("v2_cursor", ("pending_sel",)),
+    ("v3_ledger", ("sel_counts",)),
+)
+
+#: The schema version this build WRITES (must equal the last lineage
+#: entry — GLE04 errors otherwise).
+STATE_SCHEMA_VERSION = "v3_ledger"
+
+
+def _upgrade_v1_to_v2(raw: Dict[str, Any], template: Any) -> Any:
+    """v1 → v2_cursor: checkpoints older than the host-stream cursor
+    (or written by a run without ``data_placement="host_stream"``) carry
+    no ``pending_sel`` ring. The ring is transient in-flight state
+    (policy ``drop-on-shrink``) — drop it from the template and let the
+    Trainer re-prime it; never fail the whole resume over it."""
+    field = "pending_sel"
+    if getattr(template, field, None) is not None and raw.get(field) is None:
+        template = template.replace(pending_sel=None)
+    return template
+
+
+def _upgrade_v2_to_v3(raw: Dict[str, Any], template: Any) -> Any:
+    """v2_cursor → v3_ledger: checkpoints older than the selection-count
+    ledger (or from a telemetry=False run) carry no ``sel_counts``
+    entry. Restoring one into a ledger-bearing template must not fail
+    the resume — drop the field from the template and let the caller
+    keep its fresh zero ledger (policy ``re-aggregate`` over an empty
+    history is zeros)."""
+    field = "sel_counts"
+    if getattr(template, field, None) is not None and raw.get(field) is None:
+        template = template.replace(sel_counts=None)
+    return template
+
+
+#: ``(older, newer) -> shim`` for every consecutive lineage pair. Each
+#: shim is idempotent (a raw tree that already carries the field passes
+#: through untouched), so :func:`apply_upgrade_shims` can walk the whole
+#: chain unconditionally instead of guessing the on-disk version — field
+#: presence alone cannot distinguish "old checkpoint" from "HEAD run
+#: with the feature off", and both want the same template adjustment.
+UPGRADE_SHIMS = {
+    ("v1", "v2_cursor"): _upgrade_v1_to_v2,
+    ("v2_cursor", "v3_ledger"): _upgrade_v2_to_v3,
+}
+
+
+def apply_upgrade_shims(raw: Any, template: Any) -> Any:
+    """Walk the upgrade-shim chain over a raw (state-dict) checkpoint
+    tree, returning the template adjusted for fields the checkpoint
+    predates. Raises ``ValueError`` when ``raw`` carries state fields
+    this build does not know — a checkpoint written by a NEWER schema
+    must fail loudly rather than silently drop state on restore."""
+    import dataclasses
+
+    if not isinstance(raw, dict):
+        return template
+    try:
+        known = {f.name for f in dataclasses.fields(type(template))}
+    except TypeError:
+        known = None
+    if known is not None:
+        unknown = sorted(set(raw) - known)
+        if unknown:
+            raise ValueError(
+                f"checkpoint carries unknown state field(s) {unknown}: "
+                "written by a newer state schema than this build "
+                f"understands (HEAD is {STATE_SCHEMA_VERSION!r}); "
+                "refusing to restore — state would be silently dropped")
+    versions = [v for v, _ in STATE_SCHEMA_LINEAGE]
+    for pair in zip(versions, versions[1:]):
+        shim = UPGRADE_SHIMS.get(pair)
+        if shim is not None:
+            template = shim(raw, template)
+    return template
+
+
+def _state_schema_golden_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "lint", "state_schema.json")
+
+
+def state_schema_sha(path: Optional[str] = None) -> Optional[str]:
+    """The committed Layer E state-schema digest (the
+    ``state_schema_sha`` field of ``lint/state_schema.json``), or None
+    when the golden is absent/unreadable. Stamped into every checkpoint
+    manifest so restore can warn when a checkpoint predates the schema
+    the running build was linted against."""
+    path = path or _state_schema_golden_path()
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    sha = doc.get("state_schema_sha")
+    return sha if isinstance(sha, str) else None
+
+
 def _leaf_digests(to_save: Any) -> Dict[str, str]:
     """Per-leaf sha256 of the HOST value bytes, keyed by keypath string.
     Restore verifies these after parsing, so a bit flip localizes to the
@@ -256,6 +366,7 @@ def _write_manifest(path: str, file_sha: str, nbytes: int, step: int,
         "file": os.path.basename(path) + ".msgpack",
         "sha256": file_sha,
         "bytes": int(nbytes),
+        "state_schema_sha": state_schema_sha(),
         "leaves": _leaf_digests(to_save),
     }
     final = _manifest_path(path)
@@ -619,6 +730,28 @@ def _restore_one(directory: str, template: Any, step: int,
         with open(path + ".msgpack", "rb") as f:
             blob = f.read()
         doc = _load_manifest(path) if verify else None
+        if doc is not None:
+            # Schema-drift warning (non-fatal): a checkpoint stamped with
+            # a different (or no) state-schema sha predates the schema
+            # this build was linted against — the elastic path's upgrade
+            # shims cover missing fields, but the drift itself should be
+            # visible in logs and the journal, not silent.
+            want_sha = state_schema_sha()
+            have_sha = doc.get("state_schema_sha")
+            if want_sha is not None and have_sha != want_sha:
+                _log.warning(
+                    "ckpt_%d was written under a different state schema "
+                    "(manifest %s, HEAD %s): fields added since are "
+                    "covered by upgrade shims on the elastic path",
+                    step, str(have_sha)[:12], want_sha[:12])
+                if journal is not None:
+                    try:
+                        journal.emit(
+                            "checkpoint/schema_drift", int(step),
+                            detail={"manifest_sha": have_sha,
+                                    "head_sha": want_sha})
+                    except Exception:
+                        pass
         if doc is not None:
             # Whole-file digest BEFORE parsing: a torn/flipped file can
             # still deserialize into plausible garbage, and raising here

@@ -107,14 +107,12 @@ def _read_raw_state(directory: str, template: MercuryState,
 
     if raw is None:
         raw, step = probe_checkpoint(directory, step, strict=True)
-    # Upgrade shim: checkpoints written before the selection-count ledger
-    # existed (or by a telemetry=False run) carry no `sel_counts` entry;
-    # restoring one into a ledger-bearing template must not fail the
-    # whole resume — drop the field from the template and let the caller
-    # keep its fresh zero ledger.
-    if template.sel_counts is not None and isinstance(raw, dict) \
-            and raw.get("sel_counts") is None:
-        template = template.replace(sel_counts=None)
+    # Upgrade-shim chain (checkpoint.STATE_SCHEMA_LINEAGE): checkpoints
+    # written before a state field existed get that field dropped from
+    # the template (the caller keeps its fresh init), and a checkpoint
+    # carrying fields this build does not know fails LOUDLY instead of
+    # silently dropping state.
+    template = ckpt.apply_upgrade_shims(raw, template)
     # from_state_dict maps the raw dict back onto the template STRUCTURE
     # without reshaping values — exactly what elastic needs: old-shape
     # leaves inside a navigable MercuryState.
@@ -333,7 +331,11 @@ def elastic_restore(directory: str, trainer,
             "elastic/reshard_begin", restored_step,
             detail={"w_old": w_old, "w_new": w_new,
                     "l_old": l_old, "l_new": l_new,
-                    "directory": directory})
+                    "directory": directory,
+                    # The schema this build was linted against — the run
+                    # report surfaces it per reshard so a post-resume
+                    # trajectory shift can be tied to a schema change.
+                    "state_schema_sha": ckpt.state_schema_sha()})
 
     params = _check_same(old.params, ckpt._unwrap_keys(template).params,
                          "params")
@@ -387,7 +389,13 @@ def elastic_restore(directory: str, trainer,
     if journal is not None:
         journal.emit("elastic/reshard_end", restored_step,
                      parent=begin_eid,
-                     detail={"w_old": w_old, "w_new": w_new})
+                     detail={"w_old": w_old, "w_new": w_new,
+                             # Fields carried from the checkpoint (the
+                             # rest kept the new template's fresh init).
+                             "carried": sorted(
+                                 ["step", "params", "batch_stats",
+                                  "opt_state", "ema", "rng"]
+                                 + list(extra))})
     # Re-placement (global arrays multi-controller, committed TP layout)
     # is the caller's job — Trainer.restore_elastic runs the same
     # _recommit_state step the plain restore path uses.

@@ -89,6 +89,47 @@ class MercuryState:
                                     # so far (obs/sampler_health.py)
 
 
+#: Declared elastic policy per ``MercuryState`` field — the state-plane
+#: contract checked by graftlint Layer E (``lint/state.py``). A PURE
+#: literal (the linter parses it with ``ast.literal_eval``); every
+#: dataclass field above MUST have an entry here (GLE01) and every
+#: policy must have a matching carry site in ``train/elastic.py`` /
+#: ``train/trainer.py`` (GLE02). The vocabulary:
+#:
+#: - ``replicate``      — restored exactly as saved; identical on every
+#:                        worker, so (W, L) changes don't touch it.
+#: - ``reshard-exact``  — re-partitioned across the new mesh with every
+#:                        per-element value preserved bit-exactly
+#:                        (ZeRO chunks, per-sample scoretable rows).
+#: - ``re-aggregate``   — reduced to a global quantity and re-spread;
+#:                        the global reduction (sum / weighted mean) is
+#:                        invariant across the reshard.
+#: - ``re-seed``        — deliberately NOT carried by copy: derived from
+#:                        the new template's keys via ``fold_in`` so no
+#:                        two workers ever share a key (GLE05 rejects a
+#:                        plain copy).
+#: - ``cursor-fraction``— positional state carried as an epoch fraction
+#:                        and re-scaled to the new shard length.
+#: - ``drop-on-shrink`` — transient pipeline state that is deliberately
+#:                        re-initialized from the new template (and,
+#:                        where needed, re-primed by the Trainer).
+ELASTIC_POLICIES = {
+    "step": "replicate",
+    "params": "replicate",
+    "batch_stats": "replicate",
+    "opt_state": "reshard-exact",
+    "ema": "re-aggregate",
+    "stream": "cursor-fraction",
+    "rng": "re-seed",
+    "groupwise": "drop-on-shrink",
+    "pending": "drop-on-shrink",
+    "cached_pool": "drop-on-shrink",
+    "scoretable": "reshard-exact",
+    "pending_sel": "drop-on-shrink",
+    "sel_counts": "re-aggregate",
+}
+
+
 def init_worker_sampler_state(
     stream_key: jax.Array, worker_key: jax.Array,
     n_workers: int, shard_len: int,

@@ -969,6 +969,10 @@ class Trainer:
         if self._journal is not None:
             doc["events"] = self._journal.tail()
             doc["event_counts"] = self._journal.counts()
+        # The state schema this build was linted against (graftlint
+        # Layer E golden) — lets a scraper correlate restore warnings
+        # with the running build's schema without shell access.
+        doc["state_schema_sha"] = ckpt.state_schema_sha()
         return doc
 
     # -------------------------------------------------------- host streaming

@@ -388,3 +388,142 @@ class TestDurability:
         for a, b in zip(jax.tree_util.tree_leaves(tr.state.params),
                         jax.tree_util.tree_leaves(restored.params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestUpgradeShims:
+    """State-schema lineage (graftlint Layer E contract): every vintage
+    reaches HEAD through the shim chain, and a checkpoint from a NEWER
+    schema fails loudly instead of silently dropping state."""
+
+    def _template(self, mesh):
+        import jax.numpy as jnp
+        tr = Trainer(tiny(), mesh=mesh)
+        return tr.state.replace(
+            pending_sel=np.zeros((2, 4), np.int32),
+            sel_counts=jnp.zeros((8, 4), jnp.int32))
+
+    def test_v1_raw_restores_through_both_shims(self, mesh):
+        """A v1-vintage checkpoint (predates pending_sel AND sel_counts)
+        restored into a HEAD template walks two shims: both fields drop
+        from the template so restore proceeds with fresh inits."""
+        from mercury_tpu.train import checkpoint as ckpt
+
+        template = self._template(mesh)
+        raw = {"step": 0, "params": {}}  # v1 shape: neither field
+        out = ckpt.apply_upgrade_shims(raw, template)
+        assert out.pending_sel is None
+        assert out.sel_counts is None
+        # Untouched fields keep the template's values.
+        assert out.step is template.step
+
+    def test_shims_are_idempotent_on_head_checkpoints(self, mesh):
+        """A raw tree that already carries the fields passes through
+        untouched — the chain is walked unconditionally, so HEAD
+        checkpoints must survive every shim."""
+        from mercury_tpu.train import checkpoint as ckpt
+
+        template = self._template(mesh)
+        raw = {"step": 0, "pending_sel": 1, "sel_counts": 1}
+        out = ckpt.apply_upgrade_shims(raw, template)
+        assert out.pending_sel is not None
+        assert out.sel_counts is not None
+
+    def test_v2_raw_walks_only_the_second_shim(self, mesh):
+        from mercury_tpu.train import checkpoint as ckpt
+
+        template = self._template(mesh)
+        raw = {"step": 0, "pending_sel": 1}  # v2_cursor vintage
+        out = ckpt.apply_upgrade_shims(raw, template)
+        assert out.pending_sel is not None
+        assert out.sel_counts is None
+
+    def test_unknown_future_field_fails_loudly(self, mesh):
+        """A checkpoint written by a newer schema carries a field this
+        build has never heard of: refuse with a ValueError that names
+        it — NEVER restore-and-drop."""
+        from mercury_tpu.train import checkpoint as ckpt
+
+        template = self._template(mesh)
+        raw = {"step": 0, "future_fp8_scale": 7}
+        with pytest.raises(ValueError, match="future_fp8_scale"):
+            ckpt.apply_upgrade_shims(raw, template)
+
+    def test_version_literal_is_lineage_head(self):
+        from mercury_tpu.train import checkpoint as ckpt
+
+        assert ckpt.STATE_SCHEMA_VERSION == ckpt.STATE_SCHEMA_LINEAGE[-1][0]
+        pairs = list(zip([v for v, _ in ckpt.STATE_SCHEMA_LINEAGE],
+                         [v for v, _ in ckpt.STATE_SCHEMA_LINEAGE][1:]))
+        assert set(ckpt.UPGRADE_SHIMS) == set(pairs)
+
+    def test_manifest_stamps_state_schema_sha(self, mesh, tmp_path):
+        """Every new manifest carries the schema sha of the committed
+        golden, so restore can flag drift across builds."""
+        import json as _json
+
+        from mercury_tpu.train import checkpoint as ckpt
+
+        tr = Trainer(tiny(), mesh=mesh)
+        run_steps(tr, 1)
+        ckpt.save_checkpoint(str(tmp_path), tr.state, 1, manifest=True)
+        doc = _json.loads((tmp_path / "ckpt_1.manifest.json").read_text())
+        assert doc["state_schema_sha"] == ckpt.state_schema_sha()
+        assert doc["state_schema_sha"] is not None
+
+
+class TestSweepStaleTmps:
+    """Crash-orphan cleanup: only OLD .msgpack.tmp strays are swept —
+    a concurrent writer's in-flight tmp must never be unlinked."""
+
+    def _tmp(self, d, name, age_secs):
+        import time as _time
+        path = d / name
+        path.write_bytes(b"x")
+        old = _time.time() - age_secs
+        import os as _os
+        _os.utime(str(path), (old, old))
+        return path
+
+    def test_age_boundary(self, tmp_path):
+        from mercury_tpu.train.checkpoint import _sweep_stale_tmps
+
+        stale = self._tmp(tmp_path, "ckpt_3.msgpack.tmp", 400.0)
+        at_boundary = self._tmp(tmp_path, "ckpt_4.msgpack.tmp", 301.0)
+        fresh = self._tmp(tmp_path, "ckpt_5.msgpack.tmp", 0.0)
+        _sweep_stale_tmps(str(tmp_path))
+        assert not stale.exists()
+        assert not at_boundary.exists()  # >= min_age: crash orphan
+        assert fresh.exists()            # concurrent writer: untouched
+
+    def test_non_tmp_files_never_swept(self, tmp_path):
+        from mercury_tpu.train.checkpoint import _sweep_stale_tmps
+
+        payload = self._tmp(tmp_path, "ckpt_1.msgpack", 9999.0)
+        sidecar = self._tmp(tmp_path, "ckpt_1.manifest.json", 9999.0)
+        _sweep_stale_tmps(str(tmp_path), min_age_secs=1.0)
+        assert payload.exists()
+        assert sidecar.exists()
+
+    def test_custom_min_age(self, tmp_path):
+        from mercury_tpu.train.checkpoint import _sweep_stale_tmps
+
+        young = self._tmp(tmp_path, "a.msgpack.tmp", 5.0)
+        _sweep_stale_tmps(str(tmp_path), min_age_secs=60.0)
+        assert young.exists()
+        _sweep_stale_tmps(str(tmp_path), min_age_secs=1.0)
+        assert not young.exists()
+
+    def test_non_zero_process_never_sweeps(self, tmp_path, monkeypatch):
+        """Only process 0 cleans the (shared) directory — N hosts racing
+        unlinks would multiply the very race the age gate closes."""
+        from mercury_tpu.train import checkpoint as ckpt_mod
+
+        stale = self._tmp(tmp_path, "a.msgpack.tmp", 9999.0)
+        monkeypatch.setattr(ckpt_mod.jax, "process_index", lambda: 1)
+        ckpt_mod._sweep_stale_tmps(str(tmp_path), min_age_secs=1.0)
+        assert stale.exists()
+
+    def test_missing_directory_is_a_no_op(self, tmp_path):
+        from mercury_tpu.train.checkpoint import _sweep_stale_tmps
+
+        _sweep_stale_tmps(str(tmp_path / "never_created"))  # no raise

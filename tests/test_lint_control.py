@@ -246,10 +246,12 @@ class TestGoldenParity:
 
     def test_regen_all_goldens_includes_control_plane(self, tmp_path,
                                                       monkeypatch):
-        """The one-stop --regen commits control_plane.json in the same
-        transaction as the other goldens (layer measurement stubbed —
-        the tracing layers have their own tests)."""
+        """The one-stop --regen commits control_plane.json AND
+        state_schema.json in the same transaction as the other goldens
+        (layer measurement stubbed — the tracing layers have their own
+        tests)."""
         from mercury_tpu.lint import audit, concurrency, perf, sharding
+        from mercury_tpu.lint import state as state_lint
 
         monkeypatch.setattr(audit, "PLAN_NAMES", ())
         monkeypatch.setattr(audit, "ensure_cpu_devices", lambda: None)
@@ -262,16 +264,21 @@ class TestGoldenParity:
         monkeypatch.setattr(perf, "perf_budgets_doc",
                             lambda ms, rs: {"s": 1})
         ctrl = tmp_path / "control_plane.json"
+        schema = tmp_path / "state_schema.json"
         errors, warnings = golden.regen_all_goldens(
             budgets_path=str(tmp_path / "budgets.json"),
             shard_budgets_path=str(tmp_path / "shard.json"),
             manifest_path=str(tmp_path / "threads.json"),
             perf_budgets_path=str(tmp_path / "perf.json"),
-            control_path=str(ctrl))
+            control_path=str(ctrl),
+            state_schema_path=str(schema))
         assert errors == []
         doc = json.loads(ctrl.read_text())
         assert doc["schema"] == control.CONTROL_SCHEMA
         assert any("control_plane.json" in w for w in warnings)
+        sdoc = json.loads(schema.read_text())
+        assert sdoc["schema"] == state_lint.STATE_SCHEMA
+        assert any("state_schema.json" in w for w in warnings)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,247 @@
+"""graftlint Layer E: state-schema extraction, static gates
+(GLE01–GLE06), golden parity, and the differential reshard
+conformance half (GLE07–GLE10).
+
+Three seeded-violation fixtures prove the gates bite: a state field
+whose elastic policy is deleted (GLE01), a carried field whose carry
+site is removed (GLE02), and an upgrade shim that no longer names the
+field it drops (GLE03). The golden-parity tests pin the `--layer state`
+CLI contract: HEAD verifies clean against the committed
+``lint/state_schema.json``, a missing golden exits 2 with a regen hint,
+a tampered golden diffs with a CI artifact, and --regen is
+byte-stable. The differential test (slow) executes a real W=8→4→8
+round-trip and asserts policy conformance.
+"""
+
+import json
+import os
+
+import pytest
+
+from mercury_tpu.lint import golden
+from mercury_tpu.lint import state as state_lint
+
+# --------------------------------------------------------------------------
+# fixtures: the real state-plane sources, plus seeded mutations of them
+# --------------------------------------------------------------------------
+
+
+def _real_source(key: str) -> str:
+    root = os.path.dirname(os.path.dirname(state_lint.__file__))
+    path = os.path.join(root, *state_lint.STATE_MODULES[key].split("/"))
+    with open(path) as f:
+        return f.read()
+
+
+def _mutate(key: str, old: str, new: str) -> str:
+    src = _real_source(key)
+    assert old in src, f"fixture anchor {old!r} missing from {key}"
+    return src.replace(old, new)
+
+
+def field_without_policy_source() -> str:
+    """Seeded GLE01: sel_counts loses its ELASTIC_POLICIES entry."""
+    return _mutate("state", '    "sel_counts": "re-aggregate",\n', "")
+
+
+def carry_site_removed_source() -> str:
+    """Seeded GLE02: _carry_streamed_state computes the re-aggregated
+    ledger but never assigns it into extra[...] — the carried field is
+    silently discarded."""
+    return _mutate("elastic", 'extra["sel_counts"] = jnp.asarray(',
+                   "_dropped = jnp.asarray(")
+
+
+def silent_drop_shim_source() -> str:
+    """Seeded GLE03: the v2→v3 shim still works but no longer names the
+    field it drops as a string constant — a restore path that drops
+    state must say which field it drops."""
+    return _mutate("checkpoint", 'field = "sel_counts"',
+                   'field = "sel" + "_counts"')
+
+
+# --------------------------------------------------------------------------
+# extraction on HEAD
+# --------------------------------------------------------------------------
+
+
+class TestExtraction:
+    def test_every_field_has_a_policy_in_vocabulary(self):
+        facts = state_lint.extract_state_facts()
+        assert facts["field_order"], "no MercuryState fields extracted"
+        for name in facts["field_order"]:
+            pol = facts["fields"][name]["policy"]
+            assert pol in state_lint.POLICY_VOCAB, (name, pol)
+
+    def test_known_policies_and_roles(self):
+        facts = state_lint.extract_state_facts()
+        f = facts["fields"]
+        assert f["params"]["policy"] == "replicate"
+        assert f["sel_counts"]["policy"] == "re-aggregate"
+        assert f["sel_counts"]["dims"] == ["W", "L"]
+        assert f["scoretable"]["policy"] == "reshard-exact"
+        assert f["rng"]["policy"] == "re-seed"
+        assert f["rng"]["role"] == "rng-key"
+        assert f["pending_sel"]["policy"] == "drop-on-shrink"
+
+    def test_carry_sites_extracted(self):
+        facts = state_lint.extract_state_facts()
+        carry = facts["carry"]
+        assert "rng" in carry["replace_kwargs"]
+        assert any("fold_in" in e for e in carry["replace_kwargs"]["rng"])
+        assert "sel_counts" in carry["carry_extra"]
+        assert carry["extra_splat"]
+        assert carry["reprime"]["pending_sel"]
+
+    def test_lineage_and_shims_extracted(self):
+        facts = state_lint.extract_state_facts()
+        lineage = facts["lineage"]
+        assert lineage["head"] == lineage["versions"][-1]
+        for old, new in zip(lineage["versions"], lineage["versions"][1:]):
+            assert f"{old}->{new}" in facts["shims"]["pairs"]
+        assert facts["shims"]["unknown_field_raise"]
+
+    def test_manifest_parity_extracted(self):
+        facts = state_lint.extract_state_facts()
+        assert "state_schema_sha" in facts["manifest"]["keys"]
+        assert facts["manifest"]["restore_checks_sha"]
+        assert "state_schema_sha" in facts["manifest"][
+            "reshard_begin_detail"]
+
+    def test_head_extraction_has_no_findings(self):
+        facts = state_lint.extract_state_facts()
+        assert state_lint.check_extraction(facts) == []
+
+
+class TestSeededFixtures:
+    """Each planted state-contract bug must be caught by rule id."""
+
+    def test_field_without_policy_caught(self):
+        facts = state_lint.extract_state_facts(
+            sources={"state": field_without_policy_source()})
+        errors = state_lint.check_extraction(facts)
+        assert any("GLE01" in e and "sel_counts" in e
+                   for e in errors), errors
+
+    def test_carry_site_removed_caught(self):
+        facts = state_lint.extract_state_facts(
+            sources={"elastic": carry_site_removed_source()})
+        errors = state_lint.check_extraction(facts)
+        assert any("GLE02" in e and "sel_counts" in e
+                   for e in errors), errors
+
+    def test_silent_drop_shim_caught(self):
+        facts = state_lint.extract_state_facts(
+            sources={"checkpoint": silent_drop_shim_source()})
+        errors = state_lint.check_extraction(facts)
+        assert any("GLE03" in e and "sel_counts" in e
+                   for e in errors), errors
+
+    def test_rng_policy_change_caught(self):
+        # GLE05: declaring rng as anything but re-seed is a key-reuse
+        # hazard even when a carry site exists.
+        facts = state_lint.extract_state_facts(
+            sources={"state": _mutate("state", '"rng": "re-seed"',
+                                      '"rng": "replicate"')})
+        errors = state_lint.check_extraction(facts)
+        assert any("GLE05" in e and "rng" in e for e in errors), errors
+
+    def test_unstamped_manifest_caught(self):
+        # GLE06: removing the manifest stamp breaks drift detection.
+        facts = state_lint.extract_state_facts(
+            sources={"checkpoint": _mutate(
+                "checkpoint", '"state_schema_sha": state_schema_sha(),',
+                "")})
+        errors = state_lint.check_extraction(facts)
+        assert any("GLE06" in e for e in errors), errors
+
+
+# --------------------------------------------------------------------------
+# golden parity (--layer state contract)
+# --------------------------------------------------------------------------
+
+
+class TestGoldenParity:
+    def test_head_verifies_against_committed_golden(self):
+        errors, warnings = state_lint.run_state_check()
+        assert errors == [], "\n".join(errors + warnings)
+
+    def test_missing_golden_raises_file_not_found(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            state_lint.run_state_check(
+                state_schema_path=str(tmp_path / "missing.json"))
+
+    def test_tampered_golden_diffs_and_writes_artifact(self, tmp_path):
+        doc = golden.load_golden(state_lint.default_state_schema_path(),
+                                 state_lint.STATE_SCHEMA,
+                                 state_lint.REGEN_HINT)
+        doc["facts"]["fields"]["ema"]["policy"] = "replicate"
+        tampered = tmp_path / "state_schema.json"
+        tampered.write_text(json.dumps(doc))
+        out = tmp_path / "diff.txt"
+        errors, _ = state_lint.run_state_check(
+            state_schema_path=str(tampered), diff_out=str(out))
+        assert any("drifted" in e for e in errors)
+        assert "facts.fields" in out.read_text()
+
+    def test_regen_writes_byte_stable_golden(self, tmp_path):
+        p = tmp_path / "state_schema.json"
+        state_lint.run_state_check(state_schema_path=str(p), regen=True)
+        first = p.read_text()
+        state_lint.run_state_check(state_schema_path=str(p), regen=True)
+        assert p.read_text() == first
+        assert json.loads(first)["schema"] == state_lint.STATE_SCHEMA
+
+    def test_committed_sha_matches_checkpoint_module_view(self):
+        # checkpoint.state_schema_sha() reads the committed golden; the
+        # manifest stamp must equal a fresh extraction's digest.
+        from mercury_tpu.train import checkpoint as ckpt
+
+        facts = state_lint.extract_state_facts()
+        assert (ckpt.state_schema_sha()
+                == state_lint.schema_sha_of_facts(facts))
+
+    def test_sha_ignores_carry_evidence_churn(self):
+        # The stamp digests fields + lineage only — provenance or carry
+        # evidence drift must not invalidate every manifest.
+        facts = state_lint.extract_state_facts()
+        sha = state_lint.schema_sha_of_facts(facts)
+        facts2 = json.loads(json.dumps(facts))
+        facts2["carry"]["replace_kwargs"]["rng"] = ["something.else"]
+        assert state_lint.schema_sha_of_facts(facts2) == sha
+        facts3 = json.loads(json.dumps(facts))
+        facts3["lineage"]["head"] = "v99"
+        assert state_lint.schema_sha_of_facts(facts3) != sha
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        assert state_lint.main([]) == 0
+        assert "GLE01-GLE06" not in capsys.readouterr().err
+        missing = str(tmp_path / "nope.json")
+        assert state_lint.main(["--state-schema", missing]) == 2
+        assert "--regen" in capsys.readouterr().err
+
+    def test_cli_never_imports_jax(self):
+        # The static half must run on the jax-free CI lint job.
+        import subprocess
+        import sys
+        code = ("import sys; sys.modules['jax'] = None\n"
+                "from mercury_tpu.lint import state\n"
+                "sys.exit(state.main([]))\n")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              cwd=os.path.dirname(os.path.dirname(
+                                  os.path.abspath(__file__))))
+        assert proc.returncode == 0, proc.stderr
+
+
+# --------------------------------------------------------------------------
+# differential reshard conformance (GLE07–GLE10, runtime half)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestDifferential:
+    def test_round_trip_is_conformant(self):
+        findings = state_lint.run_differential(plans=("scoretable",),
+                                               steps=2)
+        assert findings == [], "\n".join(findings)

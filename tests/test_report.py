@@ -102,6 +102,47 @@ class TestRendering:
         assert "<style>" in html  # inline CSS, no external fetches
         assert "src=" not in html
 
+    def test_elastic_history_section(self, tmp_path):
+        # A reshard pair in the journal renders one Elastic-history row:
+        # mesh delta, carried fields, wall-clock, and the schema sha the
+        # restoring build was linted against.
+        base = {"mono_ns": 0, "host": 0, "step": 12}
+        with open(str(tmp_path / "events.h0.jsonl"), "w") as f:
+            f.write(json.dumps(dict(
+                base, event_id="e1", parent_id=None,
+                kind="elastic/reshard_begin", wall_s=100.0,
+                detail={"w_old": 8, "w_new": 4, "l_old": 16, "l_new": 32,
+                        "state_schema_sha": "ab" * 32})) + "\n")
+            f.write(json.dumps(dict(
+                base, event_id="e2", parent_id="e1",
+                kind="elastic/reshard_end", wall_s=101.5,
+                detail={"w_old": 8, "w_new": 4,
+                        "carried": ["ema", "params", "sel_counts"]}))
+                + "\n")
+        md = render_markdown(_run_blocks(load_run(str(tmp_path))))
+        assert "Elastic history" in md
+        assert "W 8→4, L 16→32" in md
+        assert "ema, params, sel_counts" in md
+        assert "1.50s" in md
+        assert ("ab" * 32)[:12] in md
+
+    def test_elastic_history_absent_without_reshards(self, tmp_path):
+        md = render_markdown(_run_blocks(load_run(str(tmp_path))))
+        assert "Elastic history" not in md
+
+    def test_elastic_history_incomplete_reshard(self, tmp_path):
+        # A crash between begin and end still renders the row, flagged.
+        with open(str(tmp_path / "events.h0.jsonl"), "w") as f:
+            f.write(json.dumps({
+                "event_id": "e1", "parent_id": None, "mono_ns": 0,
+                "host": 0, "step": 3, "kind": "elastic/reshard_begin",
+                "wall_s": 5.0, "detail": {"w_old": 4, "w_new": 8,
+                                          "l_old": 32, "l_new": 16}})
+                + "\n")
+        md = render_markdown(_run_blocks(load_run(str(tmp_path))))
+        assert "Elastic history" in md
+        assert "incomplete" in md
+
     def test_breakdown_section_present_when_file_exists(self, tmp_path):
         with open(str(tmp_path / "metrics.jsonl"), "w") as f:
             f.write(json.dumps(records(1)[0]) + "\n")
