@@ -53,6 +53,10 @@ import time
 import numpy as np
 
 HEADLINE_METRIC = "resnet18_cifar10_mercury_is_train_throughput"
+#: Record schema: v2 added the ``schema`` field itself and the optional
+#: ``plan`` block (--plan: resolved plan + auto-planner decision table).
+#: Pre-v2 cached records carry no schema key; readers treat that as v1.
+BENCH_SCHEMA = "mercury_bench_v2"
 LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "bench_last_good.json")
 
@@ -280,8 +284,16 @@ def bench_unfused(trainer, sc: dict) -> float:
     return sc["batch"] * sc["steps"] / dt
 
 
-def _run_bench() -> dict:
-    """The measurement itself. Assumes the backend is reachable."""
+def _run_bench(plan: str = "", plan_budget: int = 0) -> dict:
+    """The measurement itself. Assumes the backend is reachable.
+
+    With ``plan`` set (``--plan auto`` or a concrete plan name) the
+    headline IS trainer resolves through the auto-planner
+    (plan/auto.py) and the record carries the resolved plan + decision
+    table — the next chip window then measures what the planner would
+    actually pick. Plan mode pins ``scan_steps=1``: several plans
+    (host_stream family) reject scan chunking, and the planner must be
+    free to pick them."""
     import jax
 
     dev = jax.devices()[0]
@@ -298,8 +310,21 @@ def _run_bench() -> dict:
                   file=sys.stderr)
             return None
 
-    trainer = _build(sc, use_is=True, scan_steps=sc["scan"])
-    fused_ips = bench_fused(trainer, sc)
+    plan_kw = {}
+    if plan:
+        plan_kw = {"plan": plan, "plan_memory_budget_bytes": plan_budget}
+    trainer = _build(sc, use_is=True,
+                     scan_steps=1 if plan else sc["scan"], **plan_kw)
+    if plan and trainer.config.data_placement == "host_stream":
+        # The planner picked a host-streamed plan: the bare step has the
+        # pop→step→push signature, so measure through fit() (eval/log/
+        # checkpoint cadences are all off in the bench config).
+        t0 = time.perf_counter()
+        trainer.fit()
+        dt = time.perf_counter() - t0
+        fused_ips = sc["batch"] * sc["steps"] / dt
+    else:
+        fused_ips = bench_fused(trainer, sc)
     # FLOPs AFTER the timing: .lower().compile() is an AOT path that does
     # not share the jit dispatch cache, so doing it first would pay the
     # scan-chunk compile twice before any measurement. With the persistent
@@ -348,6 +373,7 @@ def _run_bench() -> dict:
         file=sys.stderr,
     )
     record = {
+        "schema": BENCH_SCHEMA,
         "metric": HEADLINE_METRIC,
         "value": round(headline_ips, 2),
         "unit": "images/sec/chip",
@@ -357,6 +383,17 @@ def _run_bench() -> dict:
         "device_kind": dev.device_kind,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if plan:
+        # Resolved plan + full decision table: what the auto-planner
+        # picked for THIS device/topology, and why everything else lost.
+        decision = getattr(trainer, "_plan_decision", None)
+        record["plan"] = {
+            "requested": plan,
+            "selected": decision.selected if decision else plan,
+            "memory_budget_bytes": plan_budget,
+            "decision_table": decision.table() if decision else None,
+        }
+        trainer.close()  # plan arms may own scorer/prefetch fleets
     if cadence_ips:
         # The cost lever's recovery, alongside the reference-semantics
         # headline: cadence K=8 throughput and its ratio to uniform.
@@ -522,6 +559,18 @@ def _parse_args(argv=None):
         help="maximum age of the record before it counts as stale "
              "(default %(default)s h)")
     p.add_argument(
+        "--plan", default=os.environ.get("MERCURY_BENCH_PLAN", ""),
+        help="resolve the headline IS trainer through the auto-planner: "
+             "'auto' picks the ranked winner, a concrete plan name "
+             "(dp, zero, hs, async, …) forces that plan; the record "
+             "carries the resolved plan + decision table (schema "
+             f"{BENCH_SCHEMA}). Default: $MERCURY_BENCH_PLAN, else off")
+    p.add_argument(
+        "--plan-memory-budget-bytes", type=int,
+        default=int(os.environ.get("MERCURY_BENCH_PLAN_BUDGET", "0") or 0),
+        help="auto-planner per-device memory budget in bytes (0 = "
+             "unbounded); candidates over budget are hard-excluded")
+    p.add_argument(
         "--profile-breakdown",
         default=os.environ.get("MERCURY_BENCH_BREAKDOWN", ""),
         help="path to a device_time_breakdown.json (obs.profile_parse "
@@ -578,14 +627,18 @@ def _apply_slo_gate(record: dict | None, args) -> int:
     return 0
 
 
-def _cpu_fallback_record() -> dict | None:
+def _cpu_fallback_record(plan: str = "", plan_budget: int = 0) -> dict | None:
     """Measure on host CPU in a FRESH subprocess. In this process the
     (dead) platform backend may already be initialized, and
     ``jax.config.update("jax_platforms", ...)`` after first backend touch
     is a silent no-op — a second in-process run would dispatch straight
     back to the dead backend and hang."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", MERCURY_BENCH_CHILD="1",
-               PALLAS_AXON_POOL_IPS="")
+               PALLAS_AXON_POOL_IPS="",
+               # The child re-parses argv-less; plan mode rides the
+               # env-backed defaults of --plan/--plan-memory-budget-bytes.
+               MERCURY_BENCH_PLAN=plan,
+               MERCURY_BENCH_PLAN_BUDGET=str(plan_budget))
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -631,7 +684,8 @@ def main():
     if os.environ.get("MERCURY_BENCH_CHILD"):
         # Fallback child: measure on whatever platform the env selects
         # (CPU) and print the record; the parent wraps it.
-        record = _run_bench()
+        record = _run_bench(plan=args.plan,
+                            plan_budget=args.plan_memory_budget_bytes)
         record["stale_reason"] = "tpu backend unreachable; host-CPU fallback"
         print(json.dumps(record))
         return
@@ -642,7 +696,8 @@ def main():
     record = None
     if backend_up:
         try:
-            record = _run_bench()
+            record = _run_bench(plan=args.plan,
+                                plan_budget=args.plan_memory_budget_bytes)
         except Exception as e:
             print(f"# bench run failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -678,7 +733,8 @@ def main():
         # contract-valid artifact.
         print("# no cache; degrading to host-CPU measurement",
               file=sys.stderr)
-        record = _cpu_fallback_record()
+        record = _cpu_fallback_record(plan=args.plan,
+                                      plan_budget=args.plan_memory_budget_bytes)
 
     if record is None:
         # Even the CPU child failed — emit a contract-shaped failure

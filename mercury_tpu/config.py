@@ -32,6 +32,24 @@ class TrainConfig:
     # Parallelism -----------------------------------------------------------
     world_size: int = 4              # number of data-parallel workers (mesh size)
     mesh_axis: str = "data"          # name of the data-parallel mesh axis
+    # Parallelism-plan selection. "" (default): manual — the knobs below
+    # are taken exactly as set. "auto": the auto-planner
+    # (plan/auto.py::resolve_plan_config) scores the graftlint plan
+    # matrix from the committed cost goldens (Layer P FLOP/byte
+    # attribution, memory_analysis() footprints, analytic collective
+    # latency) at trainer construction and overwrites the plan-defining
+    # knobs (zero_sharding, data_placement, refresh_mode, scorer_backend,
+    # fused_input, scoring_dtype, …) with the ranked winner's; the scored
+    # table is journaled as plan/selected, and restore_elastic re-plans
+    # on a (W, L) change (elastic/replan). A concrete plan name
+    # ("dp", "zero", "hs", "async", …) forces that plan's knob set while
+    # still recording where it ranked. DESIGN.md §16.
+    plan: str = ""
+    # auto-planner: per-device memory budget in bytes. Candidates whose
+    # committed memory_analysis() peak (W-scaled for sharded plans)
+    # exceeds it are HARD-excluded from the feasible set (their rejection
+    # carries rule="memory_budget"). 0 = unbounded.
+    plan_memory_budget_bytes: int = 0
     # Tensor parallelism WITHIN each data-parallel worker: a second mesh
     # axis of this size carries the Megatron column/row split of every
     # transformer block (parallel/tensor.py). The Mercury IS step runs

@@ -30,6 +30,10 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
+from mercury_tpu.utils.logging import get_logger
+
+_log = get_logger("mercury_tpu.lint.memory")
+
 MIB = 1024 ** 2
 
 #: >1 MiB intermediates in GSPMD-auto regions must carry an explicit
@@ -80,18 +84,27 @@ def format_bytes(n: int) -> str:
     return f"{n} B"
 
 
-def memory_profile(compiled) -> Dict[str, int]:
+def memory_profile(compiled) -> Dict[str, Any]:
     """``compiled.memory_analysis()`` as a plain dict of byte counts.
 
-    Returns ``{}`` when the backend provides no memory analysis (older
-    jaxlib / exotic backends) — the caller skips the memory checks then.
+    When the backend provides no memory analysis (older jaxlib / exotic
+    backends) the profile degrades to a NAMED ``{"unavailable": reason}``
+    entry instead of silently vanishing: the auto-planner must be able to
+    distinguish "no data" (plan stays feasible, decision records the gap)
+    from "fits the budget". The ratchet (:func:`compare_memory`) treats
+    an unavailable profile as no-data, so healthy-jaxlib regens are
+    byte-identical to before.
     """
     try:
         stats = compiled.memory_analysis()
-    except Exception:
-        return {}
+    except Exception as exc:
+        reason = f"{type(exc).__name__}: {exc}"
+        _log.warning("memory_analysis() unavailable on this backend: %s",
+                     reason)
+        return {"unavailable": reason}
     if stats is None:
-        return {}
+        _log.warning("memory_analysis() returned None on this backend")
+        return {"unavailable": "memory_analysis() returned None"}
     out: Dict[str, int] = {}
     for name in MEMORY_FIELDS:
         value = getattr(stats, name, None)
@@ -108,16 +121,18 @@ def memory_profile(compiled) -> Dict[str, int]:
     return out
 
 
-def compare_memory(plan: str, recorded: Dict[str, int],
-                   measured: Dict[str, int],
+def compare_memory(plan: str, recorded: Dict[str, Any],
+                   measured: Dict[str, Any],
                    tolerance: float = DEFAULT_TOLERANCE,
                    ) -> Tuple[List[str], List[str]]:
     """Monotone ratchet: ``(errors, warnings)`` against the committed
     per-plan profile. Growth past ``tolerance`` is an error; shrinking
-    past it is a warning (regenerate so the ratchet tightens)."""
+    past it is a warning (regenerate so the ratchet tightens). An
+    ``unavailable`` profile on either side is no-data: nothing to gate."""
     errors: List[str] = []
     warnings: List[str] = []
-    if not recorded or not measured:
+    if (not recorded or not measured
+            or "unavailable" in recorded or "unavailable" in measured):
         return errors, warnings
     for name in COMPARED_FIELDS:
         want, got = recorded.get(name), measured.get(name)

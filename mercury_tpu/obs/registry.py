@@ -211,6 +211,11 @@ METRIC_KEYS: Dict[str, str] = {
     # checkpoint/* — durable checkpoint writer (train/checkpoint.py)
     "checkpoint/write_failures":
         "cumulative failed checkpoint write attempts (retries included)",
+    # plan/* — auto-planner (plan/auto.py via train/trainer.py)
+    "plan/candidates_considered":
+        "plans the auto-planner enumerated for this run's decision",
+    "plan/replan_count":
+        "cumulative elastic re-plan evaluations since construction",
 }
 
 #: Control-plane event kinds (``obs/events.py`` journal rows). Same
@@ -248,6 +253,12 @@ EVENT_KINDS: Dict[str, str] = {
     # elastic/* — (W, L) resharding (train/elastic.py)
     "elastic/reshard_begin": "elastic restore started; detail has old/new W,L",
     "elastic/reshard_end": "elastic restore finished; parent = reshard_begin",
+    "elastic/replan":
+        "auto-planner re-evaluated the plan after a (W, L) change; "
+        "detail carries both scored tables",
+    # plan/* — auto-planner decision (train/trainer.py)
+    "plan/selected":
+        "plan resolution at construction; detail carries the scored table",
     # checkpoint/* — durable generations (train/checkpoint.py)
     "checkpoint/written": "a checkpoint generation was written durably",
     "checkpoint/verified": "a generation passed manifest verification",

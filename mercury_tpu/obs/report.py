@@ -344,6 +344,68 @@ def _elastic_history_blocks(events: List[Dict[str, Any]]) -> List[Block]:
     return blocks
 
 
+def _fmt_est(value: Any) -> str:
+    return f"{value:.1f}" if isinstance(value, (int, float)) else "—"
+
+
+def _plan_table_rows(table: List[Dict[str, Any]]) -> List[List[Any]]:
+    rows = []
+    for c in table or []:
+        reasons = "; ".join(
+            r.get("rule", "?") for r in (c.get("reasons") or [])) or "—"
+        mem = c.get("memory_bytes")
+        rows.append([
+            c.get("plan", "?"),
+            "yes" if c.get("feasible") else "no",
+            _fmt_est(c.get("est_steps_per_s")),
+            (f"{mem / (1024.0 ** 2):.1f}" if isinstance(mem, (int, float))
+             else c.get("memory_status", "—")),
+            reasons,
+        ])
+    return rows
+
+
+_PLAN_HEADERS = ["plan", "feasible", "est steps/s", "peak MiB", "rejected by"]
+
+
+def _plan_selection_blocks(events: List[Dict[str, Any]]) -> List[Block]:
+    """The "Plan selection" section: the auto-planner's construction-time
+    decision table (``plan/selected``) and every mid-run elastic re-plan
+    (``elastic/replan``) — which plan won, which candidates were
+    excluded, and by which machine-readable rule."""
+    selected = [e for e in events if e.get("kind") == "plan/selected"]
+    replans = [e for e in events if e.get("kind") == "elastic/replan"]
+    if not selected and not replans:
+        return []
+    blocks: List[Block] = [("h", 2, "Plan selection")]
+    for evt in selected:
+        d = evt.get("detail") or {}
+        blocks.append(("kv", [
+            ("selected plan", d.get("selected", "—")),
+            ("world size", d.get("world_size", "—")),
+            ("memory budget",
+             d.get("memory_budget_bytes") or "unbounded"),
+            ("device kind", d.get("device_kind", "—")),
+            ("candidates considered", d.get("candidates_considered", "—")),
+        ]))
+        blocks.append(("table", _PLAN_HEADERS,
+                       _plan_table_rows(d.get("table") or [])))
+    if replans:
+        blocks.append(("h", 3, "Elastic re-plans"))
+        blocks.append(("p", f"{len(replans)} re-plan evaluation(s) "
+                       "journaled across mesh changes"))
+        for evt in replans:
+            d = evt.get("detail") or {}
+            verdict = ("switched" if d.get("changed") else "kept")
+            blocks.append(("p", f"step {evt.get('step', '—')}: "
+                           f"W {d.get('w_old', '?')}→{d.get('w_new', '?')}"
+                           f": {d.get('plan_old', '?')} → "
+                           f"{d.get('plan_new', '?')} ({verdict})"))
+            blocks.append(("table", _PLAN_HEADERS,
+                           _plan_table_rows(d.get("new_table") or [])))
+    return blocks
+
+
 def _event_timeline_blocks(events: List[Dict[str, Any]]) -> List[Block]:
     """The "Run timeline" section from the control-plane event journal:
     a kind census, the causal DAG's linked events, and one reconstructed
@@ -490,6 +552,7 @@ def _run_blocks(run: Dict[str, Any]) -> List[Block]:
         blocks.append(("kv", [
             ("h2d overlap", f"{bd['h2d']['overlap_frac']:.2%}"),
             ("idle fraction", f"{bd['idle']['idle_frac']:.2%}")]))
+    blocks.extend(_plan_selection_blocks(run["events"]))
     blocks.extend(_elastic_history_blocks(run["events"]))
     blocks.extend(_event_timeline_blocks(run["events"]))
     summary = run.get("supervisor_summary")

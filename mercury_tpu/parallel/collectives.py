@@ -34,6 +34,22 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from mercury_tpu.compat import shard_map
 
+# Analytic collective-latency model — the cost side of this module's
+# executable collectives: ring/all-gather/reduce-scatter seconds from
+# payload bytes × mesh axis size × a per-link bandwidth table keyed by
+# device kind. The canonical implementation lives in the jax-free
+# ``mercury_tpu.plan.latency`` (the auto-planner and CI's jax-free leg
+# score from it without jax installed); it is surfaced here so the model
+# and the collectives it prices share one import path.
+from mercury_tpu.plan.latency import (  # noqa: F401
+    LINK_BANDWIDTH_BYTES_PER_S,
+    all_gather_cost_s,
+    collective_cost_s,
+    link_bandwidth,
+    reduce_scatter_cost_s,
+    ring_allreduce_cost_s,
+)
+
 #: SHARDING CONTRACT (enforced by graftlint Layer 3, lint/sharding.py):
 #: everything here is an EXPLICIT collective by design (the study/parity
 #: layer) — called only from inside shard_map/pmap regions, which the

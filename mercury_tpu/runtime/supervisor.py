@@ -124,11 +124,17 @@ class HostSupervisor:
 
     def __init__(self, *, restart_budget: int = 3, backoff_s: float = 0.5,
                  probe_every: int = 200, poll_s: float = 0.0,
-                 anomaly=None, journal=None) -> None:
+                 anomaly=None, journal=None, plan_provider=None) -> None:
         self._budget = max(int(restart_budget), 0)
         self._backoff_s = max(float(backoff_s), 0.0)
         self._probe_every = max(int(probe_every), 0)
         self._anomaly = anomaly
+        # Auto-planner hook: a callable returning the active plan facts
+        # ({"plan": name, "replans": n}) for status surfaces. Read-only —
+        # the supervisor never drives a re-plan itself (that is the
+        # restore_elastic path); it only reports the decision on
+        # summary()/statusz next to the ladder state.
+        self._plan_provider = plan_provider
         # Control-plane event journal (obs/events.py); None when off.
         # Its emit() is buffered, lock-leaf, and never blocks a tick.
         self._journal = journal
@@ -620,8 +626,15 @@ class HostSupervisor:
 
     def summary(self) -> Dict[str, Any]:
         """Cumulative view for flight-record context dumps."""
+        plan = None
+        if self._plan_provider is not None:
+            try:
+                plan = self._plan_provider()
+            except Exception:  # never let a status read break the ladder
+                plan = None
         with self._lock:
             return {
+                "plan": plan,
                 "level": self._level,
                 "level_name": LEVEL_NAMES[self._level],
                 "model_state": self._model_state_locked(),

@@ -114,6 +114,31 @@ class Trainer:
         dataset: Optional[ShardedDataset] = None,
         mesh=None,
     ) -> None:
+        # --- auto-planner (plan/auto.py): resolve config.plan to concrete
+        # knob overrides BEFORE anything reads the config — the dataset
+        # build keys off data_placement and the whole constructor below
+        # keys off the resolved parallelism knobs. The decision (scored
+        # table included) is journaled as plan/selected once the journal
+        # exists, and restore_elastic re-runs the planner on a (W, L)
+        # change (elastic/replan). DESIGN.md §16.
+        self._plan_decision = None
+        self._replan_count = 0
+        if getattr(config, "plan", ""):
+            from mercury_tpu.plan.auto import resolve_plan_config
+
+            config, self._plan_decision = resolve_plan_config(
+                config,
+                device_kind=jax.devices()[0].device_kind,
+                process_count=jax.process_count(),
+            )
+            _log.info(
+                "auto-planner: plan=%r resolved to %s "
+                "(%d candidates, %d feasible)",
+                self._plan_decision and config.plan,
+                self._plan_decision.selected,
+                len(self._plan_decision.candidates),
+                len(self._plan_decision.feasible),
+            )
         self.config = config
         if config.serve_port < 0 or config.serve_port > 65535:
             raise ValueError(
@@ -523,6 +548,12 @@ class Trainer:
 
             self._journal = EventJournal(config.log_dir,
                                          jax.process_index())
+            if self._plan_decision is not None:
+                # Construction-time plan resolution, scored table and
+                # per-rejection reasons in detail (report.py renders it
+                # as the "Plan selection" section).
+                self._journal.emit("plan/selected", -1,
+                                   detail=self._plan_decision.detail())
         self._faults = None
         if config.fault_spec:
             from mercury_tpu.faults import FaultPlane
@@ -664,6 +695,7 @@ class Trainer:
                 poll_s=config.supervisor_poll_s,
                 anomaly=self.anomaly,
                 journal=self._journal,
+                plan_provider=self._plan_facts,
             )
             self.logger.add_observer(self.supervisor.observe_record)
         # On-demand jax.profiler capture window: >0 means "this many more
@@ -1284,6 +1316,21 @@ class Trainer:
         self._apply_chunks([chunk], step)
 
     # ---------------------------------------------------------- flight data
+    def _plan_facts(self) -> Optional[Dict[str, Any]]:
+        """Active auto-planner decision for status surfaces (the
+        supervisor's ``summary()``/statusz ``plan`` field). None when the
+        run is manually planned."""
+        decision = self._plan_decision
+        if decision is None:
+            return None
+        return {
+            "requested": self.config.plan,
+            "selected": decision.selected,
+            "candidates_considered": len(decision.candidates),
+            "feasible": [c.name for c in decision.feasible],
+            "replans": self._replan_count,
+        }
+
     def _flight_context(self) -> Dict[str, Any]:
         """Run context for flight-record dumps (obs/anomaly.py) —
         evaluated lazily, only when a trigger actually fires."""
@@ -1474,6 +1521,13 @@ class Trainer:
                         if cfg.checkpoint_dir:
                             record["checkpoint/write_failures"] = float(
                                 ckpt.write_failures())
+                        if self._plan_decision is not None:
+                            # Auto-planner bookkeeping (host floats):
+                            # decision width + elastic re-plan count.
+                            record["plan/candidates_considered"] = float(
+                                len(self._plan_decision.candidates))
+                            record["plan/replan_count"] = float(
+                                self._replan_count)
                         # Thread-fleet liveness (Layer C telemetry):
                         # process-wide census + the metric queue's own
                         # depth; the prefetch/scorer depths rode in with
@@ -1975,11 +2029,54 @@ class Trainer:
         checkpoint tree (with its ``step``) to skip re-reading the file.
         The reference hangs on any topology change
         (``pytorch_collab.py:291-292``)."""
-        from mercury_tpu.train.elastic import elastic_restore
+        from mercury_tpu.train.elastic import (
+            elastic_restore,
+            probe_checkpoint,
+            world_size_of_raw,
+        )
 
         directory = directory or self.config.checkpoint_dir
         assert directory, "no checkpoint directory configured"
+        if raw is None:
+            raw, step = probe_checkpoint(directory, step, strict=True)
+        w_old = world_size_of_raw(raw)
         step = elastic_restore(directory, self, step, raw=raw)
+        # --- auto-planner elastic re-plan: the constructor already
+        # resolved plan="auto" for the NEW mesh; here the topology change
+        # becomes visible (w_old → world_size), so score the OLD mesh too
+        # and journal both tables — the conformance record that the plan
+        # switch (or non-switch) was a scored decision, not drift. The
+        # applied knobs are the construction-time resolution's (the whole
+        # trainer is already built on them). DESIGN.md §16.
+        if (self.config.plan == "auto" and self._plan_decision is not None
+                and w_old and w_old != self.config.world_size):
+            from mercury_tpu.plan.auto import decision_for_config
+
+            old_decision = decision_for_config(
+                self.config,
+                device_kind=jax.devices()[0].device_kind,
+                process_count=jax.process_count(),
+                world_size=w_old,
+            )
+            self._replan_count += 1
+            if self._journal is not None:
+                self._journal.emit(
+                    "elastic/replan", step,
+                    detail={
+                        "w_old": int(w_old),
+                        "w_new": int(self.config.world_size),
+                        "plan_old": old_decision.selected,
+                        "plan_new": self._plan_decision.selected,
+                        "changed": (old_decision.selected
+                                    != self._plan_decision.selected),
+                        "old_table": old_decision.table(),
+                        "new_table": self._plan_decision.table(),
+                    })
+            _log.info(
+                "auto-planner: re-plan W=%s→%s: %s → %s",
+                w_old, self.config.world_size,
+                old_decision.selected, self._plan_decision.selected,
+            )
         # host_stream: the checkpointed pending_sel ring indexes the OLD
         # (W, L) shard matrix — after elastic_restore carried the score
         # table and stream cursor across, re-prime the lookahead ring for
